@@ -1,0 +1,48 @@
+"""heat3d_tpu — a TPU-native 3D heat-equation framework.
+
+A ground-up re-design of the capability set of the reference repo
+``fredrickhang/Cuda-aware-MPI-on-3D-heate-quation`` (CUDA kernels +
+CUDA-aware MPI halo exchange + MPI_Cart_create 3D decomposition) as an
+idiomatic JAX/XLA/Pallas program:
+
+- the CUDA 7-point Jacobi stencil kernel        -> Pallas TPU kernel (``ops.stencil_pallas``)
+- CUDA-aware MPI_Isend/Irecv ghost-cell exchange -> ``shard_map`` + ``lax.ppermute``
+  over ICI (``parallel.halo``), with a Pallas ``make_async_remote_copy`` tier
+- MPI_Cart_create 3D Cartesian decomposition     -> ``jax.sharding.Mesh`` mapped onto
+  the TPU torus (``parallel.topology``)
+- the mpirun driver + time-stepping loop         -> ``jax.distributed`` entrypoint and a
+  jit-compiled ``lax.fori_loop`` time loop (``models.heat3d``, ``cli``)
+
+The reference mount is empty in this environment (see SURVEY.md §0); the
+capability spec is BASELINE.json's north star and config matrix, and
+reference-parity notes in docstrings cite SURVEY.md sections instead of
+file:line.
+"""
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    Precision,
+    RunConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.core.stencils import STENCILS, Stencil, stencil_taps
+from heat3d_tpu.models.heat3d import HeatSolver3D
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BoundaryCondition",
+    "GridConfig",
+    "MeshConfig",
+    "Precision",
+    "RunConfig",
+    "SolverConfig",
+    "StencilConfig",
+    "STENCILS",
+    "Stencil",
+    "stencil_taps",
+    "HeatSolver3D",
+]

@@ -1,0 +1,8 @@
+"""``python -m heat3d_tpu ...`` — the per-host launch entrypoint
+(SURVEY.md §2 C12: replaces ``mpirun -np N ./heat3d``)."""
+
+import sys
+
+from heat3d_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,254 @@
+"""Command-line driver — the reference's main() + mpirun surface.
+
+Reference parity (SURVEY.md §2 C4/C12, §3.1): the reference is launched as
+``mpirun -np P ./heat3d NX NY NZ NITER [Px Py Pz]``. Equivalent here::
+
+    heat3d --grid 1024 --steps 1000 --mesh 8 1 1           # config 2 (slab)
+    heat3d --grid 2048 --mesh 2 2 2                        # config 3
+    heat3d --grid 4096 --stencil 27pt --mesh 4 4 4         # config 4
+    heat3d --grid 4096 --dtype bf16 --mesh 8 4 4           # config 5
+    heat3d --grid 128 --golden-check                       # config 1
+
+One process per host on a pod slice; ``jax.distributed`` replaces mpirun
+(BASELINE.json north star). All output is JSON on stdout, human logs on
+stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    Precision,
+    RunConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.parallel import distributed
+from heat3d_tpu.utils.logging import emit_json, get_logger
+
+log = get_logger("heat3d.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="heat3d",
+        description="TPU-native 3D heat-equation solver "
+        "(capabilities of the CUDA-aware-MPI reference, re-designed for TPU)",
+    )
+    p.add_argument(
+        "--grid", type=int, nargs="+", default=[128],
+        help="global interior grid: one int (cube) or three (NX NY NZ)",
+    )
+    p.add_argument("--spacing", type=float, nargs=3, default=[1.0, 1.0, 1.0])
+    p.add_argument("--alpha", type=float, default=1.0, help="thermal diffusivity")
+    p.add_argument("--dt", type=float, default=None, help="time step (default 0.9x stable)")
+    p.add_argument("--stencil", choices=["7pt", "27pt"], default="7pt")
+    p.add_argument("--bc", choices=["dirichlet", "periodic"], default="dirichlet")
+    p.add_argument("--bc-value", type=float, default=0.0)
+    p.add_argument(
+        "--mesh", type=int, nargs="+", default=None,
+        help="device mesh Px Py Pz (one int = 1D slab; default: all devices, balanced 3D)",
+    )
+    p.add_argument("--dtype", choices=["fp32", "bf16"], default="fp32",
+                   help="field storage dtype; residual always accumulates fp32")
+    p.add_argument("--backend", choices=["auto", "jnp", "pallas"], default="auto")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--init", default="hot-cube", help="hot-cube | gaussian | random")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tol", type=float, default=None,
+                   help="run to convergence at this L2 residual instead of fixed steps")
+    p.add_argument("--residual-every", type=int, default=0,
+                   help="report residual every K steps (0 = only at end)")
+    p.add_argument("--golden-check", action="store_true",
+                   help="compare against the NumPy golden model (config 1 oracle)")
+    p.add_argument("--checkpoint", default=None, help="checkpoint directory")
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--resume", action="store_true", help="resume from --checkpoint")
+    p.add_argument("--profile-dir", default=None,
+                   help="emit a jax.profiler trace (TensorBoard/Perfetto) here")
+    p.add_argument("--coordinator", default=None, help="multi-host coordinator addr:port")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    return p
+
+
+def config_from_args(args) -> SolverConfig:
+    grid_shape = tuple(args.grid * 3 if len(args.grid) == 1 else args.grid)
+    if len(grid_shape) != 3:
+        raise SystemExit("--grid takes 1 or 3 ints")
+    if args.mesh is None:
+        mesh = MeshConfig.for_devices(len(jax.devices()))
+    elif len(args.mesh) == 1:
+        mesh = MeshConfig.slab(args.mesh[0])
+    elif len(args.mesh) == 3:
+        mesh = MeshConfig(shape=tuple(args.mesh))
+    else:
+        raise SystemExit("--mesh takes 1 or 3 ints")
+    return SolverConfig(
+        grid=GridConfig(
+            shape=grid_shape,
+            spacing=tuple(args.spacing),
+            alpha=args.alpha,
+            dt=args.dt,
+        ),
+        stencil=StencilConfig(
+            kind=args.stencil,
+            bc=BoundaryCondition(args.bc),
+            bc_value=args.bc_value,
+        ),
+        mesh=mesh,
+        precision=Precision.bf16() if args.dtype == "bf16" else Precision.fp32(),
+        run=RunConfig(
+            num_steps=args.steps,
+            tolerance=args.tol,
+            seed=args.seed,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            residual_every=args.residual_every,
+            profile_dir=args.profile_dir,
+        ),
+        backend=args.backend,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    distributed.initialize(args.coordinator, args.num_processes, args.process_id)
+    cfg = config_from_args(args)
+
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    log.info(
+        "grid=%s stencil=%s mesh=%s dtype=%s backend=%s devices=%d",
+        cfg.grid.shape, cfg.stencil.kind, cfg.mesh.shape,
+        cfg.precision.storage, cfg.backend, len(jax.devices()),
+    )
+    solver = HeatSolver3D(cfg)
+
+    start_step = 0
+    if args.resume and args.checkpoint:
+        u, start_step = solver.load_checkpoint(args.checkpoint)
+        log.info("resumed from %s at step %d", args.checkpoint, start_step)
+    else:
+        u = solver.init_state(args.init)
+
+    profile_cm = None
+    if cfg.run.profile_dir:
+        profile_cm = jax.profiler.trace(cfg.run.profile_dir)
+        profile_cm.__enter__()
+
+    # Warm up both executables outside the timed window (SURVEY.md §3.5:
+    # warmup iterations excluded). run(u, 0) compiles the multistep program
+    # without advancing; the residual program is warmed on a throwaway field.
+    u = solver.run(u, 0)
+    dummy = jax.device_put(
+        jnp.zeros(cfg.grid.shape, solver.storage_dtype), solver.sharding
+    )
+    jax.block_until_ready(solver.step_with_residual(dummy))
+    del dummy
+    jax.block_until_ready(u)
+
+    t0 = time.perf_counter()
+    residual = None
+    if cfg.run.tolerance is not None:
+        result = solver.run_to_convergence(
+            u, tol=cfg.run.tolerance, max_steps=cfg.run.num_steps
+        )
+        u, residual = result.u, result.residual
+        done = result.steps
+    else:
+        total = cfg.run.num_steps
+        done = 0
+        while done < total:
+            # Advance to the next reporting boundary: a residual point, a
+            # checkpoint point, or the end. The final step is always a
+            # residual step, so exactly `total` updates run — no overshoot.
+            boundaries = [total]
+            if args.residual_every:
+                boundaries.append(
+                    (done // args.residual_every + 1) * args.residual_every
+                )
+            if args.checkpoint and args.checkpoint_every:
+                boundaries.append(
+                    (done // args.checkpoint_every + 1) * args.checkpoint_every
+                )
+            nxt = min(min(boundaries), total)
+            n = nxt - done
+            want_residual = nxt == total or (
+                args.residual_every and nxt % args.residual_every == 0
+            )
+            if want_residual:
+                if n > 1:
+                    u = solver.run(u, n - 1)
+                u, r2 = solver.step_with_residual(u)
+                residual = float(np.sqrt(np.float64(r2)))
+                log.info("step %d residual %.6e", start_step + nxt, residual)
+            else:
+                u = solver.run(u, n)
+            done = nxt
+            if (
+                args.checkpoint
+                and args.checkpoint_every
+                and done % args.checkpoint_every == 0
+                and done < total  # final checkpoint written below
+            ):
+                solver.save_checkpoint(args.checkpoint, u, start_step + done)
+    jax.block_until_ready(u)
+    elapsed = time.perf_counter() - t0
+    steps_done = start_step + done
+
+    if profile_cm is not None:
+        profile_cm.__exit__(None, None, None)
+
+    if args.checkpoint:
+        solver.save_checkpoint(args.checkpoint, u, steps_done)
+
+    cells = cfg.grid.num_cells
+    updates = cells * max(steps_done - start_step, 1)
+    n_dev = cfg.mesh.num_devices
+    summary = {
+        "grid": list(cfg.grid.shape),
+        "stencil": cfg.stencil.kind,
+        "mesh": list(cfg.mesh.shape),
+        "dtype": cfg.precision.storage,
+        "backend": cfg.backend,
+        "steps": steps_done - start_step,
+        "seconds": elapsed,
+        "residual_l2": residual,
+        "gcell_updates_per_sec": updates / elapsed / 1e9,
+        "gcell_updates_per_sec_per_chip": updates / elapsed / 1e9 / n_dev,
+    }
+
+    if args.golden_check:
+        from heat3d_tpu.core import golden
+
+        g = golden.run(
+            golden.make_init(args.init, cfg.grid.shape, seed=cfg.run.seed),
+            cfg.grid, cfg.stencil, steps_done - start_step,
+        )
+        got = solver.gather(u).astype(np.float64)
+        err = float(np.max(np.abs(got - g)))
+        rel = err / max(float(np.max(np.abs(g))), 1e-300)
+        summary["golden_max_abs_err"] = err
+        summary["golden_rel_err"] = rel
+        tol = 1e-5 if cfg.precision.storage == "float32" else 5e-2
+        summary["golden_pass"] = bool(rel < tol)
+
+    if distributed.is_coordinator():
+        emit_json(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+"""Core: configuration dataclasses, stencil definitions, decomposition math,
+and the NumPy golden reference model. Pure Python/NumPy — no JAX imports —
+so the golden path is importable without any accelerator present.
+"""

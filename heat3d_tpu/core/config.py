@@ -1,0 +1,213 @@
+"""Configuration for the TPU-native 3D heat-equation framework.
+
+Reference parity (SURVEY.md §5 "Config / flag system"): the reference class
+parses positional argv in main() — global grid dims, iteration count,
+process-grid dims — and carries the parallelism config via ``mpirun -np``.
+Here every judged config from BASELINE.json is expressible as a frozen
+dataclass (and via the CLI front-end in ``heat3d_tpu.cli``):
+
+  1. 128^3, 7-point, single-rank golden reference   -> GridConfig(128), StencilConfig('7pt'), MeshConfig((1,1,1))
+  2. 1024^3, 7-point, 1D slab on v5p-8              -> MeshConfig((8,1,1))
+  3. 2048^3, 7-point, 3D block (2x2x2) on v5p-8     -> MeshConfig((2,2,2))
+  4. 4096^3, 27-point, 3D block on v5p-64           -> StencilConfig('27pt'), MeshConfig((4,4,4))
+  5. 4096^3, bf16 stencil + fp32 residual, v5p-128  -> Precision(compute='bfloat16', residual='float32')
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Optional, Tuple
+
+
+class BoundaryCondition(enum.Enum):
+    """Boundary handling at the global domain faces.
+
+    DIRICHLET: ghost cells hold a fixed value (default 0.0) — the canonical
+      heat-equation setup in the reference class (SURVEY.md §2 C8).
+    PERIODIC: ghost cells wrap around the torus — maps onto ppermute rings
+      with full wrap pairs (SURVEY.md §2 C3: "periodic vs non-periodic
+      boundary = ppermute ring vs shifted-edge masking").
+    """
+
+    DIRICHLET = "dirichlet"
+    PERIODIC = "periodic"
+
+
+@dataclasses.dataclass(frozen=True)
+class GridConfig:
+    """Global grid: interior cell counts, physical spacing, diffusivity.
+
+    ``shape`` counts interior (updated) cells; ghost layers are not included
+    (the reference allocates (nx+2)(ny+2)(nz+2) with ghosts — SURVEY.md §1 L0).
+    """
+
+    shape: Tuple[int, int, int]
+    spacing: Tuple[float, float, float] = (1.0, 1.0, 1.0)
+    alpha: float = 1.0  # thermal diffusivity
+    dt: Optional[float] = None  # None -> stable_dt() * 0.9
+
+    def __post_init__(self):
+        if len(self.shape) != 3 or any(s < 1 for s in self.shape):
+            raise ValueError(f"shape must be 3 positive ints, got {self.shape}")
+        if any(h <= 0 for h in self.spacing):
+            raise ValueError(f"spacing must be positive, got {self.spacing}")
+
+    @staticmethod
+    def cube(n: int, **kw) -> "GridConfig":
+        return GridConfig(shape=(n, n, n), **kw)
+
+    def stable_dt(self) -> float:
+        """Forward-Euler stability bound for the 3D diffusion operator:
+        dt <= 1 / (2*alpha*(1/hx^2 + 1/hy^2 + 1/hz^2))."""
+        hx, hy, hz = self.spacing
+        return 1.0 / (2.0 * self.alpha * (1.0 / hx**2 + 1.0 / hy**2 + 1.0 / hz**2))
+
+    def effective_dt(self) -> float:
+        return self.dt if self.dt is not None else 0.9 * self.stable_dt()
+
+    @property
+    def num_cells(self) -> int:
+        return self.shape[0] * self.shape[1] * self.shape[2]
+
+
+@dataclasses.dataclass(frozen=True)
+class StencilConfig:
+    """Which finite-difference stencil to apply.
+
+    ``kind`` selects a named member of ``core.stencils.STENCILS``:
+      '7pt'  — 2nd-order 7-point Laplacian (the reference's CUDA kernel,
+               SURVEY.md §2 C1).
+      '27pt' — isotropic 27-point Laplacian (judged config 4; needs
+               edge+corner ghost data, hence axis-ordered halo exchange).
+    """
+
+    kind: str = "7pt"
+    bc: BoundaryCondition = BoundaryCondition.DIRICHLET
+    bc_value: float = 0.0
+
+    def __post_init__(self):
+        from heat3d_tpu.core.stencils import STENCILS
+
+        if self.kind not in STENCILS:
+            raise ValueError(f"unknown stencil {self.kind!r}; have {sorted(STENCILS)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Mixed-precision policy (judged config 5: bf16 stencil + fp32 residual).
+
+    ``storage``  — dtype the field is held in (HBM traffic is proportional).
+    ``compute``  — dtype the stencil math runs in inside the kernel.
+    ``residual`` — dtype the global residual norm accumulates in; fp32
+                   regardless of storage per BASELINE.json config 5.
+    """
+
+    storage: str = "float32"
+    compute: str = "float32"
+    residual: str = "float32"
+
+    @staticmethod
+    def fp32() -> "Precision":
+        return Precision()
+
+    @staticmethod
+    def bf16() -> "Precision":
+        return Precision(storage="bfloat16", compute="float32", residual="float32")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """The Cartesian process/device topology — the MPI_Cart_create analogue.
+
+    ``shape`` = (Px, Py, Pz) device-mesh extents; total devices Px*Py*Pz.
+    Covers 1D slab (P,1,1) through full 3D block decomposition
+    (BASELINE.json configs 2-4; SURVEY.md §2 C3/C13). ``axis_names`` are the
+    jax.sharding.Mesh axis names used by every collective.
+    """
+
+    shape: Tuple[int, int, int] = (1, 1, 1)
+    axis_names: Tuple[str, str, str] = ("x", "y", "z")
+
+    def __post_init__(self):
+        if len(self.shape) != 3 or any(p < 1 for p in self.shape):
+            raise ValueError(f"mesh shape must be 3 positive ints, got {self.shape}")
+
+    @property
+    def num_devices(self) -> int:
+        return self.shape[0] * self.shape[1] * self.shape[2]
+
+    @staticmethod
+    def slab(p: int) -> "MeshConfig":
+        return MeshConfig(shape=(p, 1, 1))
+
+    @staticmethod
+    def for_devices(n: int) -> "MeshConfig":
+        """Balanced 3D factorization of n devices — the MPI_Dims_create
+        analogue (SURVEY.md §2 C3)."""
+        return MeshConfig(shape=dims_create(n))
+
+
+def dims_create(n: int) -> Tuple[int, int, int]:
+    """Factor n into a near-cubic (Px, Py, Pz), largest first — mirrors the
+    behavior of MPI_Dims_create(n, 3, dims) (SURVEY.md §2 C3)."""
+    if n < 1:
+        raise ValueError("need n >= 1")
+    best = (n, 1, 1)
+    best_score = None
+    for px in range(1, n + 1):
+        if n % px:
+            continue
+        m = n // px
+        for py in range(1, m + 1):
+            if m % py:
+                continue
+            pz = m // py
+            dims = tuple(sorted((px, py, pz), reverse=True))
+            score = max(dims) - min(dims)
+            if best_score is None or score < best_score:
+                best, best_score = dims, score
+    return best  # type: ignore[return-value]
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Driver options: iteration count, residual cadence, reporting.
+
+    Mirrors the reference main()'s argv (iters, check toggles) — SURVEY.md §2 C4.
+    """
+
+    num_steps: int = 100
+    residual_every: int = 0  # 0 = never (benchmark mode: no mid-loop syncs)
+    tolerance: Optional[float] = None  # convergence target; None = fixed steps
+    seed: int = 0
+    checkpoint_path: Optional[str] = None
+    checkpoint_every: int = 0
+    log_every: int = 0
+    profile_dir: Optional[str] = None  # jax.profiler trace output
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Everything needed to build a solver — the full judged-config surface."""
+
+    grid: GridConfig
+    stencil: StencilConfig = StencilConfig()
+    mesh: MeshConfig = MeshConfig()
+    precision: Precision = Precision()
+    run: RunConfig = RunConfig()
+    backend: str = "auto"  # 'jnp' | 'pallas' | 'auto' (pallas on TPU else jnp)
+
+    def __post_init__(self):
+        for g, p, name in zip(self.grid.shape, self.mesh.shape, "xyz"):
+            if g % p:
+                raise ValueError(
+                    f"grid dim {name}={g} not divisible by mesh dim {p}; "
+                    "the distributed path requires divisible decompositions "
+                    "(SURVEY.md §7.3 item 4)"
+                )
+
+    @property
+    def local_shape(self) -> Tuple[int, int, int]:
+        return tuple(g // p for g, p in zip(self.grid.shape, self.mesh.shape))  # type: ignore[return-value]

@@ -1,0 +1,129 @@
+"""Stencil definitions: 3x3x3 tap sets for the finite-difference operators.
+
+The reference implements one hard-coded CUDA 7-point Jacobi kernel
+(SURVEY.md §2 C1: ``u_new = c0*u + c1*(6 neighbors)``). Here a stencil is
+data — a 3x3x3 array of Laplacian weights (units 1/h^2 factored out per
+axis) — so the golden model, the jnp step, and the Pallas kernel all consume
+one definition, and the judged 27-point stencil (BASELINE.json config 4) is
+a second entry in the same table rather than a second kernel family.
+
+The time-update taps are ``T = I + dt*alpha*W`` where W is the Laplacian
+tap array scaled by the grid spacing; see :func:`stencil_taps`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Stencil:
+    """A 3x3x3 Laplacian stencil.
+
+    ``weights[di+1, dj+1, dk+1]`` multiplies ``u[i+di, j+dj, k+dk]``.
+    Weights are for unit spacing; :func:`stencil_taps` applies spacing.
+    For the 7-point stencil the anisotropic-spacing scaling is exact
+    (axis-separable); for the 27-point stencil uniform spacing is assumed
+    (validated at tap construction).
+    """
+
+    name: str
+    weights: np.ndarray  # (3,3,3) float64
+    order: int  # formal accuracy order
+    separable: bool  # True if exact under anisotropic spacing
+
+    def __post_init__(self):
+        w = np.asarray(self.weights, dtype=np.float64)
+        if w.shape != (3, 3, 3):
+            raise ValueError(f"stencil weights must be (3,3,3), got {w.shape}")
+        object.__setattr__(self, "weights", w)
+        if abs(w.sum()) > 1e-12:
+            raise ValueError(f"Laplacian taps must sum to 0, got {w.sum()}")
+
+    @property
+    def num_taps(self) -> int:
+        return int(np.count_nonzero(self.weights))
+
+
+def _seven_point() -> Stencil:
+    w = np.zeros((3, 3, 3))
+    w[1, 1, 1] = -6.0
+    w[0, 1, 1] = w[2, 1, 1] = 1.0
+    w[1, 0, 1] = w[1, 2, 1] = 1.0
+    w[1, 1, 0] = w[1, 1, 2] = 1.0
+    return Stencil(name="7pt", weights=w, order=2, separable=True)
+
+
+def _twenty_seven_point() -> Stencil:
+    """Isotropic 27-point Laplacian: center -64/15, faces 7/15, edges 1/10,
+    corners 1/30 (all / h^2). O(h^2) like the 7-point but with isotropic
+    leading error — the standard 'higher-order' compact 3D stencil
+    (BASELINE.json config 4)."""
+    w = np.empty((3, 3, 3))
+    for di in (-1, 0, 1):
+        for dj in (-1, 0, 1):
+            for dk in (-1, 0, 1):
+                manhattan = abs(di) + abs(dj) + abs(dk)
+                w[di + 1, dj + 1, dk + 1] = {
+                    0: -64.0 / 15.0,
+                    1: 7.0 / 15.0,
+                    2: 1.0 / 10.0,
+                    3: 1.0 / 30.0,
+                }[manhattan]
+    return Stencil(name="27pt", weights=w, order=2, separable=False)
+
+
+STENCILS: Dict[str, Stencil] = {s.name: s for s in (_seven_point(), _twenty_seven_point())}
+
+
+def stencil_taps(
+    stencil: Stencil,
+    alpha: float,
+    dt: float,
+    spacing: Tuple[float, float, float],
+) -> np.ndarray:
+    """Build the 3x3x3 *update* taps T such that one explicit-Euler step is
+    ``u_new[c] = sum_{d in 3x3x3} T[d] * u[c+d-1]``.
+
+    T = I + dt*alpha*W/h^2. For the separable 7-point stencil each axis pair
+    is scaled by its own 1/h_axis^2 (matching the reference's anisotropic
+    c1x/c1y/c1z coefficients, SURVEY.md §2 C1); non-separable stencils
+    require uniform spacing.
+    """
+    hx, hy, hz = spacing
+    w = stencil.weights
+    if stencil.separable:
+        scale = np.zeros((3, 3, 3))
+        # axis taps live where exactly one index differs from center
+        scale[0, 1, 1] = scale[2, 1, 1] = 1.0 / hx**2
+        scale[1, 0, 1] = scale[1, 2, 1] = 1.0 / hy**2
+        scale[1, 1, 0] = scale[1, 1, 2] = 1.0 / hz**2
+        # center balances so rows still sum to the same Laplacian
+        lap = w * scale
+        lap[1, 1, 1] = -(lap.sum() - lap[1, 1, 1])
+    else:
+        if not (hx == hy == hz):
+            raise ValueError(
+                f"stencil {stencil.name!r} requires uniform spacing, got {spacing}"
+            )
+        lap = w / hx**2
+    taps = dt * alpha * lap
+    taps[1, 1, 1] += 1.0
+    return taps
+
+
+def nonzero_taps(taps: np.ndarray):
+    """Yield ((di,dj,dk), weight) for nonzero entries, offsets in {-1,0,1}.
+
+    Iteration order is deterministic (lexicographic) so compiled programs
+    are reproducible.
+    """
+    for di in (-1, 0, 1):
+        for dj in (-1, 0, 1):
+            for dk in (-1, 0, 1):
+                v = float(taps[di + 1, dj + 1, dk + 1])
+                if v != 0.0:
+                    yield (di, dj, dk), v

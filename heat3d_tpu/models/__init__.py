@@ -1,0 +1,6 @@
+"""Solver models: user-facing facades that assemble ops + parallel layers
+into runnable simulations (the reference's main()/driver layer, re-shaped
+as a library API — SURVEY.md §2 C4).
+"""
+
+from heat3d_tpu.models.heat3d import HeatSolver3D

@@ -1,0 +1,167 @@
+"""HeatSolver3D — the flagship model: explicit 3D heat diffusion, any judged
+configuration (grid size, 7/27-point stencil, mesh decomposition, mixed
+precision), one API.
+
+Reference parity (SURVEY.md §2 C4, §3.1-3.3): everything the reference's
+main() does — topology setup, allocation, init, the time loop, residual
+checks, final report — except re-shaped as a library class whose hot path
+is a single compiled XLA program per run, launched once from Python.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.config import Precision, SolverConfig
+from heat3d_tpu.parallel.step import (
+    make_converge_fn,
+    make_multistep_fn,
+    make_step_fn,
+)
+from heat3d_tpu.parallel.topology import build_mesh, field_sharding
+from heat3d_tpu.utils import checkpoint as ckpt
+from heat3d_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _select_backend(cfg: SolverConfig):
+    """Resolve the compute backend to a padded-block compute callable.
+
+    'jnp'    — portable shifted-slice path (ops.stencil_jnp).
+    'pallas' — the Pallas TPU kernel (ops.stencil_pallas).
+    'auto'   — pallas on TPU when the local block meets the kernel's layout
+               constraints, else jnp.
+    """
+    from heat3d_tpu.ops.stencil_jnp import apply_taps_padded
+
+    if cfg.backend == "jnp":
+        return apply_taps_padded
+    if cfg.backend in ("pallas", "auto"):
+        try:
+            from heat3d_tpu.ops.stencil_pallas import (
+                make_pallas_compute,
+                pallas_supported,
+            )
+
+            ok, why = pallas_supported(cfg)
+            if ok:
+                return make_pallas_compute(cfg)
+            if cfg.backend == "pallas":
+                raise ValueError(f"pallas backend unsupported here: {why}")
+            log.info("auto backend: falling back to jnp (%s)", why)
+        except ImportError as e:
+            if cfg.backend == "pallas":
+                raise ValueError(
+                    "pallas backend requested but the Pallas kernel module "
+                    f"could not be imported: {e}"
+                ) from e
+    return apply_taps_padded
+
+
+@dataclasses.dataclass
+class RunResult:
+    u: jax.Array
+    steps: int
+    residual: Optional[float] = None
+
+
+class HeatSolver3D:
+    """Assembles mesh + sharded step functions for one SolverConfig.
+
+    Usage::
+
+        cfg = SolverConfig(grid=GridConfig.cube(128))
+        solver = HeatSolver3D(cfg)
+        u = solver.init_state("hot-cube")
+        u = solver.run(u, num_steps=100)
+    """
+
+    def __init__(self, cfg: SolverConfig, devices=None):
+        self.cfg = cfg
+        self.mesh = build_mesh(cfg.mesh, devices)
+        self.sharding = field_sharding(self.mesh, cfg.mesh)
+        compute = _select_backend(cfg)
+        self._compute = compute
+        # One executable per entrypoint; donation makes the time loop
+        # double-buffer in place (SURVEY.md §1 L0 mapping).
+        self._step = jax.jit(
+            make_step_fn(cfg, self.mesh, compute), donate_argnums=0
+        )
+        self._step_res = jax.jit(
+            make_step_fn(cfg, self.mesh, compute, with_residual=True),
+            donate_argnums=0,
+        )
+        self._multistep = jax.jit(
+            make_multistep_fn(cfg, self.mesh, compute), donate_argnums=0
+        )
+        self._converge = jax.jit(
+            make_converge_fn(cfg, self.mesh, compute), donate_argnums=0
+        )
+
+    # ---- state -----------------------------------------------------------
+
+    @property
+    def storage_dtype(self):
+        return jnp.dtype(self.cfg.precision.storage)
+
+    def init_state(self, init: Union[str, np.ndarray] = "hot-cube") -> jax.Array:
+        """Build the sharded initial field. A string selects a named
+        initializer (core.golden.INITIALIZERS); an array is used directly.
+        Materialization is per-shard via make_array_from_callback, so no
+        process ever holds the full 4096^3 field (SURVEY.md §2 C8)."""
+        shape = self.cfg.grid.shape
+        if isinstance(init, np.ndarray):
+            if init.shape != shape:
+                raise ValueError(f"init shape {init.shape} != grid {shape}")
+            arr = init.astype(self.storage_dtype)
+            return jax.make_array_from_callback(
+                shape, self.sharding, lambda idx: arr[idx]
+            )
+        name, seed = init, self.cfg.run.seed
+
+        def cb(idx):
+            block = golden.make_init_block(name, shape, idx, seed=seed)
+            return block.astype(self.storage_dtype)
+
+        return jax.make_array_from_callback(shape, self.sharding, cb)
+
+    # ---- stepping --------------------------------------------------------
+
+    def step(self, u: jax.Array) -> jax.Array:
+        return self._step(u)
+
+    def step_with_residual(self, u: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        return self._step_res(u)
+
+    def run(self, u: jax.Array, num_steps: int) -> jax.Array:
+        """num_steps updates as one device-side loop (benchmark mode: no
+        mid-loop host syncs — SURVEY.md §3.3)."""
+        return self._multistep(u, jnp.int32(num_steps))
+
+    def run_to_convergence(
+        self, u: jax.Array, tol: float, max_steps: int
+    ) -> RunResult:
+        u, steps, res = self._converge(u, jnp.int32(max_steps), jnp.float32(tol))
+        return RunResult(u=u, steps=int(steps), residual=float(res))
+
+    # ---- IO --------------------------------------------------------------
+
+    def gather(self, u: jax.Array) -> np.ndarray:
+        """Fetch the full field to host (small grids / tests only)."""
+        return np.asarray(jax.device_get(u))
+
+    def save_checkpoint(self, path: str, u: jax.Array, step: int) -> None:
+        ckpt.save(path, u, step, extra={"config": repr(self.cfg)})
+
+    def load_checkpoint(self, path: str) -> Tuple[jax.Array, int]:
+        u, step, _ = ckpt.load(path, self.sharding)
+        if u.dtype != self.storage_dtype:
+            u = u.astype(self.storage_dtype)
+        return u, step

@@ -1,0 +1,16 @@
+"""Device compute ops: the TPU-native analogue of the reference's CUDA
+kernels (SURVEY.md §2 C1/C5/C8). Two interchangeable stencil backends:
+
+- ``stencil_jnp``    — pure jax.numpy shifted-slice update; the portable
+  path and the correctness anchor for the Pallas kernel.
+- ``stencil_pallas`` — hand-written Pallas TPU kernel with rolling-plane
+  VMEM reuse; the performance path (compiled device code, like the
+  reference's ``jacobi_step<<<...>>>``).
+"""
+
+from heat3d_tpu.ops.stencil_jnp import (
+    apply_taps_padded,
+    pad_local,
+    residual_sumsq,
+    step_single_device,
+)

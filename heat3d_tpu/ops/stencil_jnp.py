@@ -1,0 +1,100 @@
+"""Pure-jnp stencil update: the portable compute backend.
+
+Reference parity (SURVEY.md §2 C1): the CUDA kernel computes
+``u_new[i,j,k] = c0*u[i,j,k] + c1*(u[i±1,..] + ...)`` one thread per cell.
+The XLA-native formulation is 7 (or 27) shifted slices of the ghost-padded
+array fused by XLA into one bandwidth-bound loop — no explicit threading.
+
+All functions take *local* interior blocks. Ghost materialization is the
+caller's job: `pad_local` for the single-device path (BC only), the halo
+exchange in ``parallel.halo`` for the distributed path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat3d_tpu.core.config import BoundaryCondition, Precision
+from heat3d_tpu.core.stencils import nonzero_taps
+
+
+def pad_local(
+    u: jax.Array, bc: BoundaryCondition, bc_value: float = 0.0
+) -> jax.Array:
+    """Single-device ghost pad: the whole domain boundary is local."""
+    if bc is BoundaryCondition.PERIODIC:
+        return jnp.pad(u, 1, mode="wrap")
+    return jnp.pad(u, 1, mode="constant", constant_values=bc_value)
+
+
+def apply_taps_padded(
+    up: jax.Array,
+    taps: np.ndarray,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+) -> jax.Array:
+    """Apply 3x3x3 update taps to a ghost-padded array ``up`` of shape
+    (nx+2, ny+2, nz+2); returns the (nx, ny, nz) interior update.
+
+    The tap loop unrolls at trace time into shifted-slice adds; XLA fuses
+    them into a single sweep (SURVEY.md §1 L1 mapping).
+    """
+    nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
+    out_dtype = out_dtype or up.dtype
+    upc = up.astype(compute_dtype)
+    acc = None
+    for (di, dj, dk), w in nonzero_taps(taps):
+        sl = upc[1 + di : 1 + di + nx, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+        term = jnp.asarray(w, compute_dtype) * sl
+        acc = term if acc is None else acc + term
+    assert acc is not None, "stencil has no taps"
+    return acc.astype(out_dtype)
+
+
+def step_single_device(
+    u: jax.Array,
+    taps: np.ndarray,
+    bc: BoundaryCondition,
+    bc_value: float = 0.0,
+    precision: Precision = Precision(),
+) -> jax.Array:
+    """One update of the full (undecomposed) field."""
+    up = pad_local(u, bc, bc_value)
+    return apply_taps_padded(
+        up,
+        taps,
+        compute_dtype=jnp.dtype(precision.compute),
+        out_dtype=jnp.dtype(precision.storage),
+    )
+
+
+def residual_sumsq(
+    u_new: jax.Array, u_old: jax.Array, residual_dtype=jnp.float32
+) -> jax.Array:
+    """Local sum of squared update differences, accumulated in
+    ``residual_dtype`` (fp32 even under bf16 storage — BASELINE.json
+    config 5; SURVEY.md §2 C5). Global reduction is the caller's psum."""
+    d = u_new.astype(residual_dtype) - u_old.astype(residual_dtype)
+    return jnp.sum(d * d, dtype=residual_dtype)
+
+
+def multistep_single_device(
+    u0: jax.Array,
+    taps: np.ndarray,
+    bc: BoundaryCondition,
+    bc_value: float,
+    num_steps: int,
+    precision: Precision = Precision(),
+) -> jax.Array:
+    """num_steps updates inside one lax.fori_loop — the whole time loop lives
+    in XLA (SURVEY.md §1 L4 mapping: double-buffering becomes the loop
+    carry, not a pointer swap)."""
+
+    def body(_, u):
+        return step_single_device(u, taps, bc, bc_value, precision)
+
+    return jax.lax.fori_loop(0, num_steps, body, u0)

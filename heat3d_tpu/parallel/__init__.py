@@ -1,0 +1,18 @@
+"""Distributed layer: the TPU-native communication backend.
+
+Replaces the reference's CUDA-aware MPI stack wholesale (SURVEY.md §2
+C2/C3/C6, §5 "Distributed communication backend"):
+
+- ``topology``    — jax.sharding.Mesh Cartesian topology (MPI_Cart_create)
+- ``halo``        — axis-ordered ppermute ghost-cell exchange
+  (MPI_Isend/Irecv/Waitall + pack/unpack kernels)
+- ``step``        — shard_map-ped distributed stencil step + psum residual
+  (MPI_Allreduce)
+- ``distributed`` — multi-host bootstrap (mpirun -> jax.distributed)
+- ``halo_pallas`` — hand-rolled ICI DMA halo tier
+  (pltpu.make_async_remote_copy — the GPUDirect RDMA analogue)
+"""
+
+from heat3d_tpu.parallel.topology import abstract_mesh, build_mesh, partition_spec
+from heat3d_tpu.parallel.halo import exchange_halo
+from heat3d_tpu.parallel.step import make_step_fn, make_multistep_fn

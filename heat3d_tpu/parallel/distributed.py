@@ -1,0 +1,64 @@
+"""Multi-host bootstrap — the mpirun replacement.
+
+Reference parity (SURVEY.md §1 L5, §3.1): the reference is launched as
+``mpirun -np P ./heat3d ...`` and calls MPI_Init to join the world. The
+TPU-native equivalent is one Python process per host running the same
+module, rendezvousing through ``jax.distributed.initialize`` (BASELINE.json
+north star: "the existing mpirun driver is replaced by a jax.distributed
+entrypoint"). On a single host this is a no-op; on a pod slice the TPU
+runtime supplies coordinates, and on plain multi-host the standard
+environment variables do (set by scripts/run_multihost.sh).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_INITIALIZED = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the distributed world if one is configured; otherwise no-op.
+
+    Resolution order: explicit args > HEAT3D_* env vars > JAX's own
+    autodetection (TPU pod runtime). Safe to call more than once.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get("HEAT3D_COORDINATOR")
+    if num_processes is None and "HEAT3D_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["HEAT3D_NUM_PROCESSES"])
+    if process_id is None and "HEAT3D_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["HEAT3D_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        # Single-process (or TPU-pod auto-config when env provides it).
+        if os.environ.get("HEAT3D_AUTO_DISTRIBUTED"):
+            jax.distributed.initialize()
+            _INITIALIZED = True
+        return
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    """True on the rank-0 analogue — gate logging/IO on this
+    (SURVEY.md §5 'Metrics / logging': rank-0 printf)."""
+    return jax.process_index() == 0

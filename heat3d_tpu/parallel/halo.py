@@ -1,0 +1,99 @@
+"""Ghost-cell halo exchange over ICI — the CUDA-aware MPI_Isend/Irecv analogue.
+
+Reference parity (SURVEY.md §2 C2, §3.2): per face the reference packs the
+boundary layer into a contiguous device buffer, posts CUDA-aware
+Isend/Irecv with device pointers, Waitalls, and unpacks into the ghost
+layer. The TPU-native formulation is one ``lax.ppermute`` per (axis,
+direction) inside ``shard_map``: XLA compiles each permute into an ICI DMA
+between neighbor chips — pack/unpack, transport, and sync all collapse
+into the collective.
+
+Key structural property: exchanges are **axis-ordered** (x, then y, then
+z), each operating on the array *already padded by previous axes*. The face
+slabs therefore carry prior ghosts with them, which propagates edge- and
+corner-ghost data in 3 exchanges instead of 26 — required by the 27-point
+stencil (SURVEY.md §7.3 item 1) and exactly equivalent to a global
+pad-then-shard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
+
+
+def _shift_perm(n: int, direction: int, periodic: bool):
+    """Permutation (source, dest) pairs shifting data one step along a ring
+    of size n. ``direction=+1``: device i's slab goes to device i+1 (so the
+    receiver sees its *low*-side neighbor's face). Non-periodic drops the
+    wrap pair; undelivered ppermute outputs are zero-filled, which is the
+    Dirichlet-0 ghost for free (nonzero BC values are patched by the
+    caller)."""
+    if periodic:
+        return [(i, (i + direction) % n) for i in range(n)]
+    if direction > 0:
+        return [(i, i + 1) for i in range(n - 1)]
+    return [(i, i - 1) for i in range(1, n)]
+
+
+def exchange_axis(
+    u: jax.Array,
+    axis: int,
+    axis_name: str,
+    axis_size: int,
+    periodic: bool,
+    bc_value: float = 0.0,
+) -> jax.Array:
+    """Pad local block ``u`` with 1 ghost layer along ``axis``, filled from
+    the neighbors along mesh axis ``axis_name`` (or the BC at the domain
+    boundary). Must run inside shard_map. Returns u grown by 2 on ``axis``.
+    """
+    lo_face = lax.slice_in_dim(u, 0, 1, axis=axis)
+    hi_face = lax.slice_in_dim(u, u.shape[axis] - 1, u.shape[axis], axis=axis)
+
+    if axis_size == 1 and periodic:
+        # self-wrap: my own faces are my ghosts
+        ghost_lo, ghost_hi = hi_face, lo_face
+    elif axis_size == 1:
+        ghost_lo = jnp.full_like(lo_face, bc_value)
+        ghost_hi = jnp.full_like(hi_face, bc_value)
+    else:
+        # my low ghost = low neighbor's high face: shift high faces "up" (+1)
+        ghost_lo = lax.ppermute(
+            hi_face, axis_name, _shift_perm(axis_size, +1, periodic)
+        )
+        # my high ghost = high neighbor's low face: shift low faces "down" (-1)
+        ghost_hi = lax.ppermute(
+            lo_face, axis_name, _shift_perm(axis_size, -1, periodic)
+        )
+        if not periodic and bc_value != 0.0:
+            idx = lax.axis_index(axis_name)
+            ghost_lo = jnp.where(idx == 0, jnp.full_like(ghost_lo, bc_value), ghost_lo)
+            ghost_hi = jnp.where(
+                idx == axis_size - 1, jnp.full_like(ghost_hi, bc_value), ghost_hi
+            )
+    return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
+
+
+def exchange_halo(
+    u: jax.Array,
+    mesh_cfg: MeshConfig,
+    bc: BoundaryCondition,
+    bc_value: float = 0.0,
+) -> jax.Array:
+    """Full 3D ghost exchange: local (nx,ny,nz) -> (nx+2,ny+2,nz+2), ghosts
+    filled from mesh neighbors / the boundary condition. Axis-ordered so the
+    result equals a global pad-then-shard (corner ghosts included). Must run
+    inside shard_map over the mesh in ``mesh_cfg``."""
+    periodic = bc is BoundaryCondition.PERIODIC
+    for axis, (axis_name, axis_size) in enumerate(
+        zip(mesh_cfg.axis_names, mesh_cfg.shape)
+    ):
+        u = exchange_axis(u, axis, axis_name, axis_size, periodic, bc_value)
+    return u

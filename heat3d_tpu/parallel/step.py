@@ -1,0 +1,133 @@
+"""The distributed stencil step: shard_map(halo exchange -> stencil -> psum).
+
+Reference parity (SURVEY.md §3.2): one reference iteration is
+``exchange_halos(u); jacobi_step<<<...>>>(u_new, u); swap; [residual +
+MPI_Allreduce]``. Here the whole iteration is one SPMD program: ghost
+exchange (ppermute), tap application (jnp slices or the Pallas kernel),
+and the fp32 residual psum, all inside ``jax.shard_map`` over the
+(x, y, z) mesh. The time loop wraps it in ``lax.fori_loop`` under jit, so
+Python launches the entire run once (SURVEY.md §1 L4 mapping).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    MeshConfig,
+    Precision,
+    SolverConfig,
+)
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
+from heat3d_tpu.parallel.halo import exchange_halo
+
+# Local compute on a ghost-padded block: (up, taps, compute_dtype, out_dtype) -> interior
+LocalCompute = Callable[..., jax.Array]
+
+
+def _solver_taps(cfg: SolverConfig) -> np.ndarray:
+    return stencil_taps(
+        STENCILS[cfg.stencil.kind],
+        cfg.grid.alpha,
+        cfg.grid.effective_dt(),
+        cfg.grid.spacing,
+    )
+
+
+def _local_step(
+    u_local: jax.Array,
+    taps: np.ndarray,
+    cfg: SolverConfig,
+    compute_padded: LocalCompute,
+) -> jax.Array:
+    up = exchange_halo(u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value)
+    return compute_padded(
+        up,
+        taps,
+        compute_dtype=jnp.dtype(cfg.precision.compute),
+        out_dtype=jnp.dtype(cfg.precision.storage),
+    )
+
+
+def make_step_fn(
+    cfg: SolverConfig,
+    mesh: Mesh,
+    compute_padded: LocalCompute = apply_taps_padded,
+    with_residual: bool = False,
+):
+    """Build the sharded one-step function ``u -> u_new`` (or
+    ``u -> (u_new, residual_sumsq)``) over global arrays sharded
+    P('x','y','z'). Not jitted — callers compose it under jit."""
+    taps = _solver_taps(cfg)
+    spec = P(*cfg.mesh.axis_names)
+    axes = cfg.mesh.axis_names
+
+    if with_residual:
+
+        def local(u_local):
+            u_new = _local_step(u_local, taps, cfg, compute_padded)
+            r = residual_sumsq(u_new, u_local, jnp.dtype(cfg.precision.residual))
+            r = lax.psum(r, axes)  # MPI_Allreduce analogue (SURVEY.md §3.3)
+            return u_new, r
+
+        return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=(spec, P()))
+
+    def local(u_local):
+        return _local_step(u_local, taps, cfg, compute_padded)
+
+    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+
+
+def make_multistep_fn(
+    cfg: SolverConfig,
+    mesh: Mesh,
+    compute_padded: LocalCompute = apply_taps_padded,
+):
+    """Build ``(u, num_steps) -> u_after`` with the fori_loop *inside* the
+    compiled program. num_steps is a traced scalar so one executable serves
+    any step count (the reference recompiles nothing either — its loop is
+    host-side; ours is device-side, SURVEY.md §3.2 TPU mapping)."""
+    step = make_step_fn(cfg, mesh, compute_padded, with_residual=False)
+
+    def run(u, num_steps):
+        return lax.fori_loop(0, num_steps, lambda _, v: step(v), u)
+
+    return run
+
+
+def make_converge_fn(
+    cfg: SolverConfig,
+    mesh: Mesh,
+    compute_padded: LocalCompute = apply_taps_padded,
+):
+    """Build ``(u, max_steps, tol) -> (u, steps_taken, last_residual)``:
+    iterate until the global L2 residual of one update drops below tol.
+    The residual check runs every step inside lax.while_loop — the
+    convergence-mode path (SURVEY.md §3.3; fixed-step benchmark mode never
+    syncs and uses make_multistep_fn instead)."""
+    step_r = make_step_fn(cfg, mesh, compute_padded, with_residual=True)
+
+    def run(u, max_steps, tol):
+        def cond(state):
+            _, i, r2 = state
+            return jnp.logical_and(i < max_steps, r2 > tol * tol)
+
+        def body(state):
+            u, i, _ = state
+            u_new, r2 = step_r(u)
+            return u_new, i + 1, r2
+
+        init = (u, jnp.zeros((), jnp.int32), jnp.full((), jnp.inf, jnp.float32))
+        u, steps, r2 = lax.while_loop(cond, body, init)
+        return u, steps, jnp.sqrt(r2)
+
+    return run

@@ -1,0 +1,78 @@
+"""Cartesian device topology — the MPI_Cart_create / MPI_Cart_shift analogue.
+
+Reference parity (SURVEY.md §2 C3): the reference builds a 3D Cartesian
+communicator and derives 6 neighbor ranks per rank. Here the topology is a
+``jax.sharding.Mesh`` with axes ('x','y','z'); neighbor relationships are
+implicit in the ppermute permutations built by ``parallel.halo``, and XLA
+maps the logical mesh onto the physical TPU torus (the "maps directly onto
+the v5p 3D torus mesh" part of BASELINE.json's north star).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import AbstractMesh, Mesh, NamedSharding, PartitionSpec
+
+from heat3d_tpu.core.config import MeshConfig
+
+
+def build_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the device mesh for (Px, Py, Pz).
+
+    With no explicit device list and a mesh spanning every visible device,
+    defer to ``jax.make_mesh`` (which picks an ICI-friendly physical
+    ordering on TPU). Otherwise take the first Px*Py*Pz devices in default
+    order — the moral equivalent of MPI_Cart_create(reorder=0).
+    """
+    n = cfg.num_devices
+    if devices is None:
+        avail = jax.devices()
+        if len(avail) == n:
+            return jax.make_mesh(cfg.shape, cfg.axis_names)
+        if len(avail) < n:
+            raise ValueError(
+                f"mesh {cfg.shape} needs {n} devices, only {len(avail)} visible"
+            )
+        devices = avail[:n]
+    dev = np.asarray(devices).reshape(cfg.shape)
+    return Mesh(dev, cfg.axis_names)
+
+
+def abstract_mesh(cfg: MeshConfig) -> AbstractMesh:
+    """Device-free mesh for compile-only lowering of multi-chip programs —
+    how multi-chip paths are validated on a single-chip dev box
+    (SURVEY.md §4 'Distributed-without-cluster', §7.0)."""
+    return AbstractMesh(cfg.shape, cfg.axis_names)
+
+
+def lower_for_mesh(fn, cfg: MeshConfig, *avals, platform: str = "tpu"):
+    """Lower ``fn`` (built over ``abstract_mesh(cfg)``) for an N-device mesh
+    with zero devices present, returning the Lowered object. The text of the
+    lowering is what tests assert collectives/shardings on — the
+    single-chip-dev-box substitute for running on a pod (SURVEY.md §4).
+    Each aval is a (shape, dtype, PartitionSpec) triple or ShapeDtypeStruct.
+    """
+    am = abstract_mesh(cfg)
+    args = []
+    for a in avals:
+        if isinstance(a, jax.ShapeDtypeStruct):
+            args.append(a)
+        else:
+            shape, dtype, spec = a
+            args.append(
+                jax.ShapeDtypeStruct(shape, dtype, sharding=NamedSharding(am, spec))
+            )
+    return jax.jit(fn).trace(*args).lower(lowering_platforms=(platform,))
+
+
+def partition_spec(cfg: MeshConfig) -> PartitionSpec:
+    """The field's sharding: block-decompose all three spatial dims over the
+    mesh axes — the direct image of the reference's 3D block decomposition."""
+    return PartitionSpec(*cfg.axis_names)
+
+
+def field_sharding(mesh: Mesh, cfg: MeshConfig) -> NamedSharding:
+    return NamedSharding(mesh, partition_spec(cfg))

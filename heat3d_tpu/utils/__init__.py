@@ -1,0 +1,3 @@
+"""Host-side runtime utilities: logging, timing, checkpointing, profiling —
+the observability/aux subsystems of SURVEY.md §5.
+"""

@@ -1,0 +1,119 @@
+"""Checkpoint / resume: per-shard .npy files + a JSON manifest.
+
+Reference parity (SURVEY.md §5 'Checkpoint / resume'): the reference class
+has at most a final-state dump; this implements the planned superset —
+save/restore of the field and iteration count, sharded so each process
+writes only its addressable shards (multi-host safe, no gather), with a
+replicated fast path for small grids. No Orbax dependency by design.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+MANIFEST = "manifest.json"
+
+# np.save cannot represent ml_dtypes extension dtypes (bfloat16 -> raw '|V2');
+# store them as a same-width integer view and view back on load.
+_RAW_VIEWS = {"bfloat16": np.uint16, "float8_e4m3fn": np.uint8, "float8_e5m2": np.uint8}
+
+
+def _to_saveable(arr: np.ndarray) -> np.ndarray:
+    raw = _RAW_VIEWS.get(str(arr.dtype))
+    return arr.view(raw) if raw is not None else arr
+
+
+def _from_saved(arr: np.ndarray, dtype_str: str) -> np.ndarray:
+    if dtype_str in _RAW_VIEWS:
+        import ml_dtypes
+
+        return arr.view(np.dtype(getattr(ml_dtypes, dtype_str)))
+    return arr.astype(np.dtype(dtype_str), copy=False)
+
+
+def _shard_filename(start: Tuple[int, ...]) -> str:
+    return "shard_" + "_".join(str(s) for s in start) + ".npy"
+
+
+def _index_start(index, shape) -> Tuple[int, ...]:
+    return tuple(0 if sl.start is None else int(sl.start) for sl in index)
+
+
+def save(path: str, u: jax.Array, step: int, extra: Optional[dict] = None) -> None:
+    """Write the sharded field at ``path`` (a directory). Every process
+    writes its own shards; process 0 writes the manifest."""
+    os.makedirs(path, exist_ok=True)
+    for shard in u.addressable_shards:
+        start = _index_start(shard.index, u.shape)
+        np.save(
+            os.path.join(path, _shard_filename(start)),
+            _to_saveable(np.asarray(shard.data)),
+        )
+    if jax.process_index() == 0:
+        manifest = {
+            "step": int(step),
+            "global_shape": list(u.shape),
+            "dtype": str(u.dtype),
+            "format": 1,
+            "extra": extra or {},
+        }
+        tmp = os.path.join(path, MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2)
+        os.replace(tmp, os.path.join(path, MANIFEST))
+
+
+def load_manifest(path: str) -> dict:
+    with open(os.path.join(path, MANIFEST)) as f:
+        return json.load(f)
+
+
+def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
+    """Restore (field, step, extra) onto ``sharding``. Works for any mesh
+    shape whose shard boundaries align with the saved files' blocks (the
+    usual resume-on-same-mesh case), and for any mesh when the save was
+    single-shard."""
+    manifest = load_manifest(path)
+    shape = tuple(manifest["global_shape"])
+    dtype_str = manifest["dtype"]
+
+    single = os.path.join(path, _shard_filename((0,) * len(shape)))
+    full = None
+    if os.path.exists(single):
+        arr = np.load(single)
+        if arr.shape == shape:
+            full = _from_saved(arr, dtype_str)
+
+    def cb(index):
+        if full is not None:
+            return full[index]
+        start = _index_start(index, shape)
+        fname = os.path.join(path, _shard_filename(start))
+        if not os.path.exists(fname):
+            raise FileNotFoundError(
+                f"checkpoint {path} has no shard starting at {start}; "
+                "resume mesh must match the save mesh (or save single-device)"
+            )
+        arr = np.load(fname)
+        want = tuple(
+            (0 if sl.stop is None else sl.stop) - (0 if sl.start is None else sl.start)
+            for sl, n in zip(index, shape)
+        )
+        # normalize: slices with stop=None mean full axis
+        want = tuple(
+            n if (sl.start is None and sl.stop is None) else w
+            for sl, n, w in zip(index, shape, want)
+        )
+        if arr.shape != want:
+            raise ValueError(
+                f"shard at {start} has shape {arr.shape}, sharding wants {want}"
+            )
+        return _from_saved(arr, dtype_str)
+
+    u = jax.make_array_from_callback(shape, sharding, cb)
+    return u, int(manifest["step"]), manifest.get("extra", {})

@@ -1,0 +1,57 @@
+"""Process-0 structured logging.
+
+Reference parity (SURVEY.md §5 'Metrics / logging'): the reference printf-s
+residuals and final throughput from rank 0. Here: a stdlib logger that is
+silent on non-coordinator processes, plus JSON emission for benchmark
+results so scaling tables regenerate mechanically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, Dict
+
+
+class _Process0Filter(logging.Filter):
+    """Drop INFO-and-below on non-coordinator processes.
+
+    The check is lazy and only consults jax.process_index() once the XLA
+    backend is already initialized: calling it earlier would itself
+    initialize the backend and break a later jax.distributed.initialize()
+    (which must run first in multi-host launches)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if record.levelno > logging.INFO:
+            return True
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge.backends_are_initialized():
+                return True  # pre-init logs: assume coordinator
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:  # pragma: no cover
+            return True
+
+
+def get_logger(name: str = "heat3d") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s %(name)s %(levelname)s] %(message)s")
+        )
+        handler.addFilter(_Process0Filter())
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def emit_json(record: Dict[str, Any], stream=None) -> None:
+    """Print one machine-readable JSON line (benchmark contract)."""
+    stream = stream or sys.stdout
+    print(json.dumps(record), file=stream, flush=True)

@@ -1,0 +1,36 @@
+"""Wall-clock timing with device synchronization.
+
+Reference parity (SURVEY.md §2 C9, §3.5): the reference brackets its loop
+with MPI_Barrier + MPI_Wtime. The TPU equivalent of the barrier+Wtime pair
+is ``jax.block_until_ready`` around ``time.perf_counter``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+import jax
+
+
+def time_fn(fn, *args, warmup: int = 1, iters: int = 5) -> List[float]:
+    """Per-call wall times of ``fn(*args)`` with block_until_ready, after
+    ``warmup`` excluded calls (compile + cache warm). Returns all iter
+    times so callers can take p50/p95 (the halo-latency metric)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile without numpy (tiny lists)."""
+    if not values:
+        raise ValueError("no values")
+    s = sorted(values)
+    k = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[k]

@@ -1,0 +1,31 @@
+"""Shared fixtures. Test strategy per SURVEY.md §4: NumPy golden oracle,
+single-device jnp vs golden, distributed (1,1,1)-mesh vs single-device,
+compile-only lowering for multi-chip meshes (this box has one TPU and no
+CPU multi-device simulation — SURVEY.md §7.0).
+"""
+
+import os
+import sys
+
+# Allow running from a source checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)
+
+
+def small_field(shape=(8, 8, 8), seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+
+
+@pytest.fixture
+def field8():
+    return small_field((8, 8, 8))
+
+
+FP32_TOL = 1e-5  # relative, single step

@@ -1,0 +1,176 @@
+"""Unit tests: configs, stencil taps, decomposition index math, golden model
+(SURVEY.md §4 'Unit' tier — the checks the reference never had)."""
+
+import numpy as np
+import pytest
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    SolverConfig,
+    StencilConfig,
+    dims_create,
+)
+from heat3d_tpu.core import decomposition as dec
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.stencils import STENCILS, nonzero_taps, stencil_taps
+
+
+# ---- configs ---------------------------------------------------------------
+
+
+def test_stable_dt_isotropic():
+    g = GridConfig.cube(8, alpha=2.0)
+    assert g.stable_dt() == pytest.approx(1.0 / (2.0 * 2.0 * 3.0))
+
+
+def test_solver_config_rejects_indivisible():
+    with pytest.raises(ValueError, match="not divisible"):
+        SolverConfig(grid=GridConfig.cube(10), mesh=MeshConfig(shape=(4, 1, 1)))
+
+
+def test_dims_create_balanced():
+    assert dims_create(8) == (2, 2, 2)
+    assert dims_create(64) == (4, 4, 4)
+    assert dims_create(1) == (1, 1, 1)
+    assert dims_create(12) in ((3, 2, 2),)
+    px, py, pz = dims_create(7)
+    assert px * py * pz == 7
+
+
+def test_unknown_stencil_rejected():
+    with pytest.raises(ValueError, match="unknown stencil"):
+        StencilConfig(kind="9pt")
+
+
+# ---- stencil taps ----------------------------------------------------------
+
+
+def test_taps_sum_to_one():
+    # Laplacian weights sum to zero => update taps sum to one (a constant
+    # field is a steady state under periodic BC).
+    for name, st in STENCILS.items():
+        taps = stencil_taps(st, alpha=0.7, dt=0.05, spacing=(1.0, 1.0, 1.0))
+        assert taps.sum() == pytest.approx(1.0, abs=1e-12), name
+
+
+def test_7pt_tap_values():
+    taps = stencil_taps(STENCILS["7pt"], alpha=1.0, dt=0.1, spacing=(1.0, 1.0, 1.0))
+    assert taps[1, 1, 1] == pytest.approx(1.0 - 0.6)
+    assert taps[0, 1, 1] == pytest.approx(0.1)
+    assert np.count_nonzero(taps) == 7
+
+
+def test_7pt_anisotropic_spacing():
+    taps = stencil_taps(STENCILS["7pt"], alpha=1.0, dt=0.01, spacing=(1.0, 2.0, 4.0))
+    assert taps[0, 1, 1] == pytest.approx(0.01 / 1.0)
+    assert taps[1, 0, 1] == pytest.approx(0.01 / 4.0)
+    assert taps[1, 1, 0] == pytest.approx(0.01 / 16.0)
+    assert taps.sum() == pytest.approx(1.0)
+
+
+def test_27pt_requires_uniform_spacing():
+    with pytest.raises(ValueError, match="uniform spacing"):
+        stencil_taps(STENCILS["27pt"], alpha=1.0, dt=0.01, spacing=(1.0, 1.0, 2.0))
+
+
+def test_27pt_has_27_taps():
+    taps = stencil_taps(STENCILS["27pt"], 1.0, 0.01, (1.0, 1.0, 1.0))
+    assert np.count_nonzero(taps) == 27
+    assert len(list(nonzero_taps(taps))) == 27
+
+
+# ---- golden model ----------------------------------------------------------
+
+
+def test_golden_hand_computed_center():
+    # 3x3x3 field, single hot center cell, one 7pt step, Dirichlet-0:
+    # center:  c0*1 = 1-6r ; face neighbors: r each.
+    u = np.zeros((3, 3, 3), dtype=np.float32)
+    u[1, 1, 1] = 1.0
+    g = GridConfig.cube(3, dt=0.1)
+    taps = stencil_taps(STENCILS["7pt"], 1.0, 0.1, (1.0, 1.0, 1.0))
+    out = golden.step(u, taps)
+    assert out[1, 1, 1] == pytest.approx(1.0 - 0.6)
+    assert out[0, 1, 1] == pytest.approx(0.1)
+    assert out[1, 0, 1] == pytest.approx(0.1)
+    assert out[1, 1, 2] == pytest.approx(0.1)
+    assert out[0, 0, 1] == 0.0  # edge cell: no mass after one step
+
+
+def test_golden_conservation_periodic():
+    u = golden.random_init((6, 7, 8), seed=3).astype(np.float64)
+    taps = stencil_taps(STENCILS["27pt"], 1.0, 0.02, (1.0, 1.0, 1.0))
+    out = golden.step(u, taps, bc=BoundaryCondition.PERIODIC)
+    assert out.sum() == pytest.approx(u.sum(), rel=1e-12)
+
+
+def test_golden_constant_steady_state():
+    u = np.full((5, 5, 5), 3.25)
+    for name in STENCILS:
+        taps = stencil_taps(STENCILS[name], 1.0, 0.05, (1.0, 1.0, 1.0))
+        out = golden.step(u, taps, bc=BoundaryCondition.PERIODIC)
+        np.testing.assert_allclose(out, u, rtol=1e-13)
+        # Dirichlet with matching bc_value is also steady
+        out = golden.step(
+            u, taps, bc=BoundaryCondition.DIRICHLET, bc_value=3.25
+        )
+        np.testing.assert_allclose(out, u, rtol=1e-13)
+
+
+def test_golden_decay_dirichlet():
+    # With zero Dirichlet BC heat leaks out: norm strictly decreases.
+    u = golden.gaussian_init((10, 10, 10)).astype(np.float64)
+    g = GridConfig.cube(10)
+    taps = stencil_taps(STENCILS["7pt"], 1.0, g.effective_dt(), (1.0, 1.0, 1.0))
+    norms = [np.abs(u).sum()]
+    for _ in range(5):
+        u = golden.step(u, taps)
+        norms.append(np.abs(u).sum())
+    assert all(b < a for a, b in zip(norms, norms[1:]))
+
+
+def test_init_block_matches_full():
+    shape = (12, 10, 8)
+    for name in ("hot-cube", "gaussian", "random"):
+        full = golden.make_init(name, shape, seed=5)
+        block = golden.make_init_block(
+            name, shape, (slice(3, 9), slice(0, 5), slice(4, 8)), seed=5
+        )
+        np.testing.assert_array_equal(full[3:9, 0:5, 4:8], block)
+
+
+# ---- decomposition ---------------------------------------------------------
+
+
+def test_coords_roundtrip():
+    mesh = (2, 3, 4)
+    for r in range(24):
+        assert dec.rank_of_coords(dec.coords_of_rank(r, mesh), mesh) == r
+
+
+def test_local_extent_uneven():
+    # 10 cells over 3 parts -> 4,3,3 with correct offsets
+    assert dec.local_extent(10, 3, 0) == (0, 4)
+    assert dec.local_extent(10, 3, 1) == (4, 3)
+    assert dec.local_extent(10, 3, 2) == (7, 3)
+    # cover the whole range exactly once
+    total = sum(dec.local_extent(10, 3, i)[1] for i in range(3))
+    assert total == 10
+
+
+def test_subdomains_tile_grid():
+    grid, mesh = (8, 9, 10), (2, 3, 1)
+    seen = np.zeros(grid, dtype=int)
+    for sd in dec.all_subdomains(grid, mesh):
+        seen[sd.slices] += 1
+    assert (seen == 1).all()
+
+
+def test_neighbor_rank_edges():
+    mesh = (3, 1, 1)
+    assert dec.neighbor_rank(0, mesh, 0, -1, periodic=False) is None
+    assert dec.neighbor_rank(0, mesh, 0, -1, periodic=True) == 2
+    assert dec.neighbor_rank(2, mesh, 0, +1, periodic=False) is None
+    assert dec.neighbor_rank(1, mesh, 0, +1, periodic=False) == 2

@@ -1,0 +1,192 @@
+"""Distributed-path tests.
+
+Executable tier: the full shard_map machinery on a (1,1,1) mesh must equal
+the single-device step bitwise (the '-np 1 vs -np P' check, SURVEY.md §4).
+Compile-only tier: multi-chip meshes lower via AbstractMesh with the
+expected collectives present — the single-chip substitute for a pod
+(SURVEY.md §7.0: no multi-device simulation exists on this box).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    Precision,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.ops.stencil_jnp import step_single_device
+from heat3d_tpu.parallel.halo import exchange_halo
+from heat3d_tpu.parallel.step import make_converge_fn, make_multistep_fn, make_step_fn
+from heat3d_tpu.parallel.topology import abstract_mesh, build_mesh, lower_for_mesh
+from jax.sharding import PartitionSpec as P
+
+
+def solo_cfg(n=8, kind="7pt", bc=BoundaryCondition.DIRICHLET, bc_value=0.0,
+             precision=Precision.fp32()):
+    return SolverConfig(
+        grid=GridConfig.cube(n),
+        stencil=StencilConfig(kind=kind, bc=bc, bc_value=bc_value),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        precision=precision,
+        backend="jnp",
+    )
+
+
+# ---- executable on this box ------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize(
+    "bc,bc_value",
+    [
+        (BoundaryCondition.DIRICHLET, 0.0),
+        (BoundaryCondition.DIRICHLET, 2.0),
+        (BoundaryCondition.PERIODIC, 0.0),
+    ],
+)
+def test_sharded_equals_single_device(kind, bc, bc_value):
+    cfg = solo_cfg(kind=kind, bc=bc, bc_value=bc_value)
+    mesh = build_mesh(cfg.mesh)
+    step = make_step_fn(cfg, mesh)
+    u = jnp.asarray(golden.random_init((8, 8, 8), seed=4))
+    got = jax.jit(step)(u)
+    taps = stencil_taps(STENCILS[kind], 1.0, cfg.grid.effective_dt(), (1.0,) * 3)
+    want = step_single_device(u, taps, bc, bc_value)
+    # Same math and precision; XLA may fuse the two programs differently
+    # (observed: 1-ulp fma differences), so compare at ulp scale, not bitwise.
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_halo_111_mesh_equals_pad():
+    # On a (1,1,1) mesh the halo exchange must reproduce pad_local exactly:
+    # periodic wrap = self-exchange, Dirichlet = bc fill.
+    from heat3d_tpu.ops.stencil_jnp import pad_local
+
+    u = jnp.asarray(golden.random_init((5, 6, 7), seed=9))
+    for bc, bcv in [
+        (BoundaryCondition.PERIODIC, 0.0),
+        (BoundaryCondition.DIRICHLET, 0.0),
+        (BoundaryCondition.DIRICHLET, 3.5),
+    ]:
+        cfg = MeshConfig(shape=(1, 1, 1))
+        mesh = build_mesh(cfg)
+        f = jax.shard_map(
+            lambda x: exchange_halo(x, cfg, bc, bcv),
+            mesh=mesh,
+            in_specs=P("x", "y", "z"),
+            out_specs=P("x", "y", "z"),
+        )
+        got = f(u)
+        want = pad_local(u, bc, bcv)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_residual_psum_replicated():
+    cfg = solo_cfg()
+    mesh = build_mesh(cfg.mesh)
+    step = make_step_fn(cfg, mesh, with_residual=True)
+    u = jnp.asarray(golden.gaussian_init((8, 8, 8)))
+    u2, r2 = jax.jit(step)(u)
+    want = float(jnp.sum((u2.astype(jnp.float32) - u) ** 2))
+    assert float(r2) == pytest.approx(want, rel=1e-6)
+
+
+def test_convergence_residual_decreases():
+    cfg = solo_cfg()
+    mesh = build_mesh(cfg.mesh)
+    conv = jax.jit(make_converge_fn(cfg, mesh))
+    u = jnp.asarray(golden.gaussian_init((8, 8, 8)))
+    u1, s1, r1 = conv(u, jnp.int32(3), jnp.float32(0.0))
+    u2, s2, r2 = conv(u1, jnp.int32(3), jnp.float32(0.0))
+    assert int(s1) == 3 and int(s2) == 3
+    assert float(r2) < float(r1)
+    # generous tol converges immediately-ish
+    _, s3, _ = conv(u2, jnp.int32(50), jnp.float32(1e3))
+    assert int(s3) <= 1
+
+
+def test_multistep_traced_step_count():
+    cfg = solo_cfg()
+    mesh = build_mesh(cfg.mesh)
+    ms = jax.jit(make_multistep_fn(cfg, mesh))
+    step = jax.jit(make_step_fn(cfg, mesh))
+    u = jnp.asarray(golden.random_init((8, 8, 8), seed=11))
+    got = ms(u, jnp.int32(3))
+    want = step(step(step(u)))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+    )
+
+
+# ---- compile-only: multi-chip meshes (SURVEY.md §4 distributed tier) -------
+
+
+@pytest.mark.parametrize(
+    "mesh_shape,kind",
+    [
+        ((8, 1, 1), "7pt"),   # config 2: 1D slab v5p-8
+        ((2, 2, 2), "7pt"),   # config 3: 3D block v5p-8
+        ((4, 4, 4), "27pt"),  # config 4: v5p-64
+    ],
+)
+def test_multichip_step_lowers_with_collectives(mesh_shape, kind):
+    n = 16 if max(mesh_shape) <= 4 else 32
+    cfg = SolverConfig(
+        grid=GridConfig.cube(max(n, max(mesh_shape) * 2)),
+        stencil=StencilConfig(kind=kind),
+        mesh=MeshConfig(shape=mesh_shape),
+        backend="jnp",
+    )
+    am = abstract_mesh(cfg.mesh)
+    step = make_step_fn(cfg, am, with_residual=True)
+    lowered = lower_for_mesh(
+        step, cfg.mesh,
+        (cfg.grid.shape, jnp.float32, P("x", "y", "z")),
+    )
+    txt = lowered.as_text()
+    assert "collective-permute" in txt or "collective_permute" in txt
+    assert "all-reduce" in txt or "all_reduce" in txt  # the residual psum
+
+
+def test_bf16_strong_scale_config_lowers():
+    # config 5: bf16 stencil + fp32 residual on a 128-chip mesh
+    cfg = SolverConfig(
+        grid=GridConfig.cube(256),
+        mesh=MeshConfig(shape=(8, 4, 4)),
+        precision=Precision.bf16(),
+        backend="jnp",
+    )
+    am = abstract_mesh(cfg.mesh)
+    step = make_step_fn(cfg, am, with_residual=True)
+    lowered = lower_for_mesh(
+        step, cfg.mesh, (cfg.grid.shape, jnp.bfloat16, P("x", "y", "z"))
+    )
+    txt = lowered.as_text()
+    assert "bf16" in txt
+    assert "f32" in txt  # fp32 residual accumulation survives
+
+
+def test_multistep_loop_is_device_side():
+    cfg = SolverConfig(
+        grid=GridConfig.cube(16),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        backend="jnp",
+    )
+    am = abstract_mesh(cfg.mesh)
+    ms = make_multistep_fn(cfg, am)
+    lowered = lower_for_mesh(
+        ms, cfg.mesh,
+        (cfg.grid.shape, jnp.float32, P("x", "y", "z")),
+        jax.ShapeDtypeStruct((), jnp.int32),
+    )
+    assert "while" in lowered.as_text()

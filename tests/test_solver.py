@@ -1,0 +1,152 @@
+"""End-to-end solver + checkpoint + CLI tests (SURVEY.md §4 integration tier)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from heat3d_tpu import GridConfig, HeatSolver3D, SolverConfig, StencilConfig
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.config import BoundaryCondition, MeshConfig, Precision
+
+
+def make_solver(n=16, **kw):
+    cfg = SolverConfig(grid=GridConfig.cube(n), backend="jnp", **kw)
+    return HeatSolver3D(cfg), cfg
+
+
+def test_solver_matches_golden_end_to_end():
+    solver, cfg = make_solver()
+    u = solver.init_state("hot-cube")
+    u = solver.run(u, 10)
+    want = golden.run(
+        golden.make_init("hot-cube", cfg.grid.shape), cfg.grid, cfg.stencil, 10
+    )
+    got = solver.gather(u).astype(np.float64)
+    rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+    assert rel < 1e-5
+
+
+def test_solver_27pt_periodic_matches_golden():
+    solver, cfg = make_solver(
+        stencil=StencilConfig(kind="27pt", bc=BoundaryCondition.PERIODIC)
+    )
+    u = solver.init_state("random")
+    u = solver.run(u, 5)
+    want = golden.run(
+        golden.make_init("random", cfg.grid.shape, seed=0),
+        cfg.grid, cfg.stencil, 5,
+    )
+    got = solver.gather(u).astype(np.float64)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_solver_tracks_fp32():
+    s16, cfg = make_solver(precision=Precision.bf16())
+    s32, _ = make_solver(precision=Precision.fp32())
+    u16 = s16.run(s16.init_state("gaussian"), 5)
+    u32 = s32.run(s32.init_state("gaussian"), 5)
+    a = s16.gather(u16).astype(np.float32)
+    b = s32.gather(u32)
+    assert np.max(np.abs(a - b)) < 0.05 * max(1.0, np.max(np.abs(b)))
+
+
+def test_convergence_mode():
+    solver, _ = make_solver()
+    u = solver.init_state("gaussian")
+    res = solver.run_to_convergence(u, tol=1e-3, max_steps=5000)
+    assert res.residual is not None and res.residual <= 1e-3
+    assert 0 < res.steps < 5000
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    solver, cfg = make_solver()
+    u = solver.run(solver.init_state("hot-cube"), 3)
+    path = str(tmp_path / "ckpt")
+    solver.save_checkpoint(path, u, step=3)
+    u2, step = solver.load_checkpoint(path)
+    assert step == 3
+    np.testing.assert_array_equal(solver.gather(u), solver.gather(u2))
+    # resumed run equals uninterrupted run
+    a = solver.gather(solver.run(u2, 4))
+    fresh, _ = make_solver()
+    b = fresh.gather(fresh.run(fresh.init_state("hot-cube"), 7))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_roundtrip_bf16(tmp_path):
+    # np.save degrades ml_dtypes bfloat16 to raw '|V2'; the checkpoint layer
+    # must view through uint16 (regression: review finding).
+    solver, cfg = make_solver(precision=Precision.bf16())
+    u = solver.run(solver.init_state("gaussian"), 2)
+    path = str(tmp_path / "ckbf16")
+    solver.save_checkpoint(path, u, step=2)
+    u2, step = solver.load_checkpoint(path)
+    assert step == 2 and u2.dtype == jax.numpy.bfloat16
+    np.testing.assert_array_equal(
+        solver.gather(u).view(np.uint16), solver.gather(u2).view(np.uint16)
+    )
+
+
+def test_cli_exact_step_count_and_periodic_checkpoint(tmp_path, capsys):
+    # --steps N must run exactly N updates even with --residual-every, and
+    # --checkpoint-every must fire on its grid (regression: review findings).
+    from heat3d_tpu.cli import main
+    from heat3d_tpu.utils import checkpoint as ckpt
+
+    ck = str(tmp_path / "ck")
+    rc = main([
+        "--grid", "16", "--steps", "10", "--residual-every", "4",
+        "--checkpoint", ck, "--checkpoint-every", "4", "--backend", "jnp",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] == 10
+    assert ckpt.load_manifest(ck)["step"] == 10
+    want = golden.run(
+        golden.make_init("hot-cube", (16, 16, 16)),
+        SolverConfig(grid=GridConfig.cube(16)).grid,
+        StencilConfig(),
+        10,
+    )
+    solver, _ = make_solver()
+    u2, step = solver.load_checkpoint(ck)
+    np.testing.assert_allclose(
+        solver.gather(u2).astype(np.float64), want, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_cli_json_summary(capsys):
+    from heat3d_tpu.cli import main
+
+    rc = main(["--grid", "16", "--steps", "5", "--golden-check", "--backend", "jnp"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out)
+    assert summary["golden_pass"] is True
+    assert summary["grid"] == [16, 16, 16]
+    assert summary["gcell_updates_per_sec_per_chip"] > 0
+
+
+def test_cli_checkpoint_resume(tmp_path, capsys):
+    from heat3d_tpu.cli import main
+
+    ck = str(tmp_path / "ck")
+    assert main(["--grid", "16", "--steps", "4", "--checkpoint", ck,
+                 "--backend", "jnp"]) == 0
+    capsys.readouterr()
+    assert main(["--grid", "16", "--steps", "2", "--checkpoint", ck,
+                 "--resume", "--backend", "jnp"]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["steps"] >= 2
+
+
+def test_init_state_mesh_invariant():
+    # The initializer must not depend on the decomposition (SURVEY.md §2 C8):
+    # block-wise init == full init slice for the random initializer.
+    solver, cfg = make_solver()
+    u = solver.gather(solver.init_state("random"))
+    want = golden.make_init("random", cfg.grid.shape, seed=0)
+    np.testing.assert_array_equal(u, want)

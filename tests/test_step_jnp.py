@@ -1,0 +1,100 @@
+"""Single-device jnp step vs the NumPy golden model — the serial-reference
+check the reference class builds in (BASELINE.json config 1; SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat3d_tpu.core.config import BoundaryCondition, GridConfig, Precision
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.ops.stencil_jnp import (
+    multistep_single_device,
+    pad_local,
+    residual_sumsq,
+    step_single_device,
+)
+
+
+def taps_for(kind, dt=0.05, spacing=(1.0, 1.0, 1.0)):
+    return stencil_taps(STENCILS[kind], 1.0, dt, spacing)
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize(
+    "bc,bc_value",
+    [
+        (BoundaryCondition.DIRICHLET, 0.0),
+        (BoundaryCondition.DIRICHLET, 1.5),
+        (BoundaryCondition.PERIODIC, 0.0),
+    ],
+)
+def test_step_matches_golden(kind, bc, bc_value):
+    u = golden.random_init((9, 8, 7), seed=2)
+    taps = taps_for(kind)
+    want = golden.step(u, taps, bc, bc_value)
+    got = step_single_device(jnp.asarray(u), taps, bc, bc_value)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-6, atol=2e-6)
+
+
+def test_multistep_equals_repeated_steps():
+    u = golden.gaussian_init((8, 8, 8))
+    taps = taps_for("7pt")
+    bc = BoundaryCondition.DIRICHLET
+    got = multistep_single_device(jnp.asarray(u), taps, bc, 0.0, num_steps=4)
+    want = jnp.asarray(u)
+    for _ in range(4):
+        want = step_single_device(want, taps, bc, 0.0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-12
+    )
+
+
+def test_anisotropic_spacing_matches_golden():
+    u = golden.random_init((6, 6, 6), seed=7)
+    taps = taps_for("7pt", dt=0.01, spacing=(1.0, 2.0, 0.5))
+    want = golden.step(u, taps)
+    got = step_single_device(jnp.asarray(u), taps, BoundaryCondition.DIRICHLET)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-6, atol=2e-6)
+
+
+def test_bf16_storage_fp32_compute():
+    # bf16 storage halves HBM traffic; compute in fp32 keeps one-step error
+    # at bf16 rounding scale (BASELINE.json config 5).
+    u = golden.gaussian_init((8, 8, 8))
+    taps = taps_for("7pt")
+    prec = Precision.bf16()
+    got = step_single_device(
+        jnp.asarray(u, jnp.bfloat16), taps, BoundaryCondition.DIRICHLET,
+        precision=prec,
+    )
+    assert got.dtype == jnp.bfloat16
+    want = golden.step(u, taps)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), want, rtol=2e-2, atol=2e-2
+    )
+
+
+def test_residual_fp32_under_bf16():
+    a = jnp.asarray(golden.random_init((6, 6, 6), 1), jnp.bfloat16)
+    b = jnp.asarray(golden.random_init((6, 6, 6), 2), jnp.bfloat16)
+    r = residual_sumsq(a, b)
+    assert r.dtype == jnp.float32
+    want = np.sum(
+        (np.asarray(a, np.float32) - np.asarray(b, np.float32)) ** 2
+    )
+    np.testing.assert_allclose(np.asarray(r), want, rtol=1e-6)
+
+
+def test_pad_local_wrap_and_constant():
+    u = jnp.arange(8.0).reshape(2, 2, 2)
+    w = pad_local(u, BoundaryCondition.PERIODIC)
+    np.testing.assert_array_equal(
+        np.asarray(w), np.pad(np.asarray(u), 1, mode="wrap")
+    )
+    c = pad_local(u, BoundaryCondition.DIRICHLET, 9.0)
+    np.testing.assert_array_equal(
+        np.asarray(c), np.pad(np.asarray(u), 1, constant_values=9.0)
+    )
