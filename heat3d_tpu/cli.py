@@ -22,7 +22,6 @@ import time
 from typing import List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from heat3d_tpu.core.config import (
@@ -148,15 +147,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         profile_cm = jax.profiler.trace(cfg.run.profile_dir)
         profile_cm.__enter__()
 
-    # Warm up both executables outside the timed window (SURVEY.md §3.5:
-    # warmup iterations excluded). run(u, 0) compiles the multistep program
-    # without advancing; the residual program is warmed on a throwaway field.
-    u = solver.run(u, 0)
-    dummy = jax.device_put(
-        jnp.zeros(cfg.grid.shape, solver.storage_dtype), solver.sharding
-    )
-    jax.block_until_ready(solver.step_with_residual(dummy))
-    del dummy
+    # Warm up the executables this mode will use, outside the timed window
+    # (SURVEY.md §3.5: warmup iterations excluded). The dummy field is built
+    # per-shard (zeros callback) so no process ever materializes the full
+    # global array — same rule as init_state.
+    def _dummy():
+        return jax.make_array_from_callback(
+            cfg.grid.shape,
+            solver.sharding,
+            lambda idx: np.zeros(
+                tuple(
+                    (n if s.stop is None else s.stop)
+                    - (0 if s.start is None else s.start)
+                    for n, s in zip(cfg.grid.shape, idx)
+                ),
+                solver.storage_dtype,
+            ),
+        )
+
+    if cfg.run.tolerance is not None:
+        # while_loop cond is false at max_steps=0: compiles without advancing
+        solver.run_to_convergence(_dummy(), tol=1.0, max_steps=0)
+    else:
+        u = solver.run(u, 0)
+        jax.block_until_ready(solver.step_with_residual(_dummy()))
     jax.block_until_ready(u)
 
     t0 = time.perf_counter()
@@ -233,9 +247,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.golden_check:
         from heat3d_tpu.core import golden
 
+        # steps_done counts from t=0 even on --resume: the golden model must
+        # advance the original init by the run's TOTAL step count, not just
+        # the resumed segment.
         g = golden.run(
             golden.make_init(args.init, cfg.grid.shape, seed=cfg.run.seed),
-            cfg.grid, cfg.stencil, steps_done - start_step,
+            cfg.grid, cfg.stencil, steps_done,
         )
         got = solver.gather(u).astype(np.float64)
         err = float(np.max(np.abs(got - g)))

@@ -6,10 +6,6 @@ class validates parallel runs against a serial run; this module is that
 serial run, kept deliberately dumb (pad + 27 shifted adds in float64) so it
 can be trusted as ground truth for every other path (jnp step, Pallas
 kernel, distributed shard_map run).
-
-When the optional C extension (``heat3d_tpu.utils.native``) is built, a
-fast native stepper is available via ``step(..., impl='c')`` — the analogue
-of the reference's compiled CPU reference path.
 """
 
 from __future__ import annotations

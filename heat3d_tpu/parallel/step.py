@@ -11,8 +11,7 @@ Python launches the entire run once (SURVEY.md §1 L4 mapping).
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Optional, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
