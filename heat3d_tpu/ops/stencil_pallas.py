@@ -1,0 +1,180 @@
+"""Pallas TPU stencil kernel — the native compute path.
+
+Reference parity (SURVEY.md §2 C1): the reference's CUDA ``__global__``
+Jacobi kernel (one thread per cell, 3D thread blocks). The TPU-native
+formulation tiles the ghost-padded local block over a 2D Pallas grid of
+(x, y) output tiles; each program holds a halo-overlapped input window in
+VMEM — ``Element``-indexed BlockSpecs give the overlapping reads, Mosaic's
+grid pipeline double-buffers the HBM->VMEM streaming — and evaluates the
+3x3x3 taps as statically-unrolled shifted-slice FMAs on the VPU. The z
+axis stays whole: it is the lane dimension, so ±1 shifts along it are
+cheap in-register lane shifts, and the 8x128 (fp32) tile constraint is
+respected by keeping (y, z) as the trailing dims.
+
+The kernel computes in ``compute_dtype`` (fp32 even for bf16 storage by
+default — BASELINE.json config 5's "bf16 stencil + fp32 residual" policy)
+and writes ``out_dtype``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # Element-indexed (overlapping-window) block dims
+    from jax._src.pallas.core import Element as _Element
+except ImportError:  # pragma: no cover - older/newer pallas layouts
+    _Element = None
+
+from heat3d_tpu.core.config import SolverConfig
+from heat3d_tpu.core.stencils import nonzero_taps
+
+# VMEM working-set budget for one grid step. The hardware has ~16 MB; the
+# pipeline needs two in-flight input windows plus the output tile, and
+# Mosaic wants headroom for spills, so aim the *per-step* set under ~5 MB.
+_VMEM_STEP_BUDGET = 5 * 1024 * 1024
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _divisors_desc(n: int, cap: int):
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            yield d
+
+
+def _vmem_step_bytes(
+    bx: int, by: int, nz: int, in_itemsize: int, out_itemsize: int
+) -> int:
+    """Estimate one grid step's VMEM footprint with TPU tile padding."""
+    in_bytes = (
+        (bx + 2) * _round_up(by + 2, _SUBLANE) * _round_up(nz + 2, _LANE) * in_itemsize
+    )
+    out_bytes = bx * _round_up(by, _SUBLANE) * _round_up(nz, _LANE) * out_itemsize
+    return in_bytes + out_bytes
+
+
+def choose_blocks(
+    local_shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
+) -> Optional[Tuple[int, int]]:
+    """Pick (bx, by) output-tile sizes for a (nx, ny, nz) local block, or
+    None if no divisor combination fits the VMEM budget."""
+    nx, ny, nz = local_shape
+    for by in _divisors_desc(ny, 256):
+        # prefer sublane-aligned y tiles when the extent allows it
+        if by % _SUBLANE and ny % _SUBLANE == 0 and by < ny:
+            continue
+        for bx in _divisors_desc(nx, 8):
+            if _vmem_step_bytes(bx, by, nz, in_itemsize, out_itemsize) <= _VMEM_STEP_BUDGET:
+                return bx, by
+    return None
+
+
+def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
+    """Can the Pallas kernel run this config's local blocks?"""
+    if _Element is None:
+        return False, "pallas Element block dims unavailable in this jax"
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        return False, f"platform is {platform!r}, kernel targets TPU"
+    if jnp.dtype(cfg.precision.storage).itemsize not in (2, 4):
+        return False, f"unsupported storage dtype {cfg.precision.storage}"
+    blocks = choose_blocks(
+        cfg.local_shape,
+        jnp.dtype(cfg.precision.storage).itemsize,
+        jnp.dtype(cfg.precision.storage).itemsize,
+    )
+    if blocks is None:
+        return False, f"no block tiling of {cfg.local_shape} fits VMEM"
+    return True, ""
+
+
+def _stencil_kernel(in_ref, out_ref, *, taps, bx, by, nz, compute_dtype, out_dtype):
+    """One (bx, by, nz) output tile from a (bx+2, by+2, nz+2) input window.
+
+    The tap loop unrolls at trace time; each term is a static shifted slice
+    of the VMEM window, so Mosaic sees a chain of vector FMAs (z shifts are
+    lane shifts, y shifts sublane shifts, x shifts plane selects).
+    """
+    acc = None
+    for (di, dj, dk), w in taps:
+        sl = in_ref[
+            1 + di : 1 + di + bx, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz
+        ].astype(compute_dtype)
+        term = compute_dtype(w) * sl
+        acc = term if acc is None else acc + term
+    out_ref[:] = acc.astype(out_dtype)
+
+
+def apply_taps_pallas(
+    up: jax.Array,
+    taps: np.ndarray,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas analogue of ops.stencil_jnp.apply_taps_padded: ghost-padded
+    (nx+2, ny+2, nz+2) block in, (nx, ny, nz) interior update out."""
+    nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
+    out_dtype = out_dtype or up.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    blocks = choose_blocks(
+        (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+    )
+    if blocks is None:
+        raise ValueError(f"no VMEM-feasible tiling for local shape {(nx, ny, nz)}")
+    bx, by = blocks
+    tap_list = tuple(nonzero_taps(taps))
+
+    kernel = functools.partial(
+        _stencil_kernel,
+        taps=tap_list,
+        bx=bx,
+        by=by,
+        nz=nz,
+        compute_dtype=compute_dtype,
+        out_dtype=jnp.dtype(out_dtype),
+    )
+    flops_per_cell = 2 * len(tap_list)
+    return pl.pallas_call(
+        kernel,
+        grid=(nx // bx, ny // by),
+        in_specs=[
+            pl.BlockSpec(
+                (_Element(bx + 2), _Element(by + 2), nz + 2),
+                lambda i, j: (i * bx, j * by, 0),
+            )
+        ],
+        out_specs=pl.BlockSpec((bx, by, nz), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_cell * nx * ny * nz,
+            bytes_accessed=(nx + 2) * (ny + 2) * (nz + 2) * up.dtype.itemsize
+            + nx * ny * nz * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(up)
+
+
+def make_pallas_compute(cfg: SolverConfig, interpret: bool = False):
+    """Build the LocalCompute callable for parallel.step: same signature as
+    apply_taps_padded, kernel-backed."""
+
+    def compute(up, taps, compute_dtype=jnp.float32, out_dtype=None):
+        return apply_taps_pallas(
+            up, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
+            interpret=interpret,
+        )
+
+    return compute

@@ -1,7 +1,7 @@
 """Shared fixtures. Test strategy per SURVEY.md §4: NumPy golden oracle,
 single-device jnp vs golden, distributed (1,1,1)-mesh vs single-device,
-compile-only lowering for multi-chip meshes (this box has one TPU and no
-CPU multi-device simulation — SURVEY.md §7.0).
+real 8-device CPU-mesh subprocess checks (test_multidevice.py), and
+compile-only lowering for larger multi-chip meshes (SURVEY.md §7.0).
 """
 
 import os

@@ -1,0 +1,221 @@
+"""Real multi-device distributed checks, run on an 8-device CPU mesh.
+
+Executed as a subprocess by tests/test_multidevice.py with the axon PJRT
+plugin disabled (env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8) — the software
+equivalent of the reference class's ``mpirun -np 8`` oversubscription test
+(SURVEY.md §4): the decomposed run must reproduce the undecomposed run.
+
+Not named test_* so pytest does not collect it in the main (axon) process.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    Precision,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.ops.stencil_jnp import step_single_device
+from heat3d_tpu.parallel.halo import exchange_halo
+from heat3d_tpu.parallel.step import make_multistep_fn, make_step_fn
+from heat3d_tpu.parallel.topology import build_mesh, field_sharding
+
+
+def check_step_matches_single_device():
+    """Decomposed step == undecomposed step, across mesh shapes, stencils,
+    BCs, and precisions — the '-np 1 vs -np P' oracle."""
+    grid = (16, 16, 16)
+    u_host = golden.random_init(grid, seed=7)
+    for mesh_shape in [(8, 1, 1), (2, 2, 2), (1, 2, 4), (2, 4, 1)]:
+        for kind in ("7pt", "27pt"):
+            for bc, bcv in [
+                (BoundaryCondition.DIRICHLET, 0.0),
+                (BoundaryCondition.DIRICHLET, 1.5),
+                (BoundaryCondition.PERIODIC, 0.0),
+            ]:
+                cfg = SolverConfig(
+                    grid=GridConfig(shape=grid),
+                    stencil=StencilConfig(kind=kind, bc=bc, bc_value=bcv),
+                    mesh=MeshConfig(shape=mesh_shape),
+                    backend="jnp",
+                )
+                mesh = build_mesh(cfg.mesh)
+                sharding = field_sharding(mesh, cfg.mesh)
+                u = jax.device_put(jnp.asarray(u_host), sharding)
+                got = jax.jit(make_step_fn(cfg, mesh))(u)
+                taps = stencil_taps(
+                    STENCILS[kind], cfg.grid.alpha, cfg.grid.effective_dt(),
+                    cfg.grid.spacing,
+                )
+                want = step_single_device(jnp.asarray(u_host), taps, bc, bcv)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+                    err_msg=f"mesh={mesh_shape} kind={kind} bc={bc} bcv={bcv}",
+                )
+    print("step_matches_single_device OK")
+
+
+def check_bf16_distributed():
+    grid = (16, 16, 16)
+    cfg = SolverConfig(
+        grid=GridConfig(shape=grid),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        precision=Precision.bf16(),
+        backend="jnp",
+    )
+    mesh = build_mesh(cfg.mesh)
+    u_host = golden.gaussian_init(grid)
+    u = jax.device_put(
+        jnp.asarray(u_host, jnp.bfloat16), field_sharding(mesh, cfg.mesh)
+    )
+    got, r2 = jax.jit(make_step_fn(cfg, mesh, with_residual=True))(u)
+    assert got.dtype == jnp.bfloat16
+    assert r2.dtype == jnp.float32
+    # single-device same policy
+    cfg1 = SolverConfig(
+        grid=GridConfig(shape=grid), stencil=cfg.stencil,
+        mesh=MeshConfig(shape=(1, 1, 1)), precision=cfg.precision, backend="jnp",
+    )
+    mesh1 = build_mesh(cfg1.mesh, devices=jax.devices()[:1])
+    want, r2_1 = jax.jit(make_step_fn(cfg1, mesh1, with_residual=True))(
+        jax.device_put(jnp.asarray(u_host, jnp.bfloat16),
+                       field_sharding(mesh1, cfg1.mesh))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.astype(jnp.float32)), np.asarray(want.astype(jnp.float32))
+    )
+    # the 8-way psum reduces partial sums in a different order than the
+    # single-device sum — identical values, different fp32 rounding path
+    assert float(r2) == pytest.approx(float(r2_1), rel=1e-5)
+    print("bf16_distributed OK")
+
+
+def check_halo_ghost_identity():
+    """Rank-constant shards: after exchange, each ghost layer holds the
+    neighbor's rank id (periodic wrap included) — the direct analogue of the
+    reference's ghost-fill correctness check (SURVEY.md §4)."""
+    mesh_cfg = MeshConfig(shape=(2, 2, 2))
+    mesh = build_mesh(mesh_cfg)
+    local = (4, 4, 4)
+    grid = tuple(l * p for l, p in zip(local, mesh_cfg.shape))
+
+    def rank_field():
+        # global array whose value in each shard is its linear device index
+        def linear_rank(x, y, z):
+            return (x // local[0]) * 4 + (y // local[1]) * 2 + (z // local[2])
+
+        idx = np.indices(grid)
+        return jnp.asarray(linear_rank(*idx).astype(np.float32))
+
+    u = jax.device_put(rank_field(), field_sharding(mesh, mesh_cfg))
+
+    for bc in (BoundaryCondition.PERIODIC, BoundaryCondition.DIRICHLET):
+        f = jax.jit(
+            jax.shard_map(
+                lambda x: exchange_halo(x, mesh_cfg, bc, bc_value=-1.0),
+                mesh=mesh,
+                in_specs=P("x", "y", "z"),
+                out_specs=P("x", "y", "z"),
+            )
+        )
+        padded = f(u)  # global (2*(4+2),)*3 array of per-shard padded blocks
+        blocks = np.asarray(padded).reshape(2, 6, 2, 6, 2, 6).transpose(
+            0, 2, 4, 1, 3, 5
+        )  # [px,py,pz][local 6,6,6]
+        for px in range(2):
+            for py in range(2):
+                for pz in range(2):
+                    b = blocks[px, py, pz]
+                    me = px * 4 + py * 2 + pz
+                    assert (b[1:-1, 1:-1, 1:-1] == me).all()
+                    # x-low ghost: neighbor (px-1, py, pz); with size-2 axes,
+                    # periodic wrap neighbor == the other device
+                    for axis, (lo_nb, hi_nb) in enumerate(
+                        [
+                            ((1 - px) * 4 + py * 2 + pz,) * 2,
+                            (px * 4 + (1 - py) * 2 + pz,) * 2,
+                            (px * 4 + py * 2 + (1 - pz),) * 2,
+                        ]
+                    ):
+                        coord = (px, py, pz)[axis]
+                        sl_lo = [slice(1, -1)] * 3
+                        sl_hi = [slice(1, -1)] * 3
+                        sl_lo[axis] = 0
+                        sl_hi[axis] = 5
+                        lo = b[tuple(sl_lo)]
+                        hi = b[tuple(sl_hi)]
+                        if bc is BoundaryCondition.PERIODIC:
+                            assert (lo == lo_nb).all(), (axis, coord, "lo")
+                            assert (hi == hi_nb).all(), (axis, coord, "hi")
+                        else:
+                            # domain-boundary ghosts hold bc_value, interior
+                            # ghosts hold the neighbor id
+                            assert (lo == (-1.0 if coord == 0 else lo_nb)).all()
+                            assert (hi == (-1.0 if coord == 1 else hi_nb)).all()
+    print("halo_ghost_identity OK")
+
+
+def check_multistep_vs_golden():
+    grid = (16, 16, 16)
+    cfg = SolverConfig(
+        grid=GridConfig(shape=grid),
+        stencil=StencilConfig(kind="27pt", bc=BoundaryCondition.PERIODIC),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        backend="jnp",
+    )
+    mesh = build_mesh(cfg.mesh)
+    u_host = golden.gaussian_init(grid)
+    u = jax.device_put(jnp.asarray(u_host), field_sharding(mesh, cfg.mesh))
+    got = jax.jit(make_multistep_fn(cfg, mesh))(u, jnp.int32(5))
+    want = golden.run(u_host.astype(np.float64), cfg.grid, cfg.stencil, 5)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+    print("multistep_vs_golden OK")
+
+
+def check_sharded_checkpoint_roundtrip():
+    import tempfile
+
+    from heat3d_tpu.utils import checkpoint as ckpt
+
+    mesh_cfg = MeshConfig(shape=(2, 2, 2))
+    mesh = build_mesh(mesh_cfg)
+    sharding = field_sharding(mesh, mesh_cfg)
+    u_host = golden.random_init((8, 8, 8), seed=3)
+    u = jax.device_put(jnp.asarray(u_host), sharding)
+    with tempfile.TemporaryDirectory() as d:
+        ckpt.save(d, u, step=42)
+        u2, step, _ = ckpt.load(d, sharding)
+        assert step == 42
+        np.testing.assert_array_equal(np.asarray(u2), np.asarray(u))
+    print("sharded_checkpoint_roundtrip OK")
+
+
+def main():
+    n = len(jax.devices())
+    assert n == 8, f"expected 8 CPU devices, got {n} ({jax.devices()})"
+    check_step_matches_single_device()
+    check_bf16_distributed()
+    check_halo_ghost_identity()
+    check_multistep_vs_golden()
+    check_sharded_checkpoint_roundtrip()
+    print("ALL MULTIDEVICE CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -1,0 +1,37 @@
+"""Launch the real 8-device distributed checks in a CPU-mesh subprocess.
+
+The dev box's axon PJRT plugin (single real TPU) is injected by
+sitecustomize only when PALLAS_AXON_POOL_IPS is set; unsetting it frees
+JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=8 to provide a
+genuine 8-device mesh. This is the moral equivalent of the reference
+class's ``mpirun -np 8`` single-node oversubscription test (SURVEY.md §4).
+"""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_multidevice_checks_on_cpu_mesh():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disable axon plugin injection
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE), env.get("PYTHONPATH", "")]
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "multidevice_checks.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"multidevice checks failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "ALL MULTIDEVICE CHECKS PASSED" in proc.stdout

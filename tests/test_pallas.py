@@ -1,0 +1,133 @@
+"""Pallas stencil kernel tests.
+
+Interpret-mode tier runs on any platform (kernel semantics vs the jnp
+step — SURVEY.md §4 'Pallas stencil kernel ≡ jnp step'). Compiled tier
+runs only when a real TPU is visible.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat3d_tpu.core.config import (
+    GridConfig,
+    MeshConfig,
+    Precision,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.ops.stencil_jnp import apply_taps_padded
+from heat3d_tpu.ops.stencil_pallas import (
+    apply_taps_pallas,
+    choose_blocks,
+    pallas_supported,
+)
+
+ON_TPU = jax.devices()[0].platform == "tpu"
+
+
+def _taps(kind):
+    return stencil_taps(STENCILS[kind], 1.0, 0.05, (1.0, 1.0, 1.0))
+
+
+def _padded(shape, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.standard_normal(tuple(s + 2 for s in shape)).astype(dtype)
+    )
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize("shape", [(8, 8, 8), (16, 32, 24), (24, 8, 40)])
+def test_interpret_matches_jnp(kind, shape):
+    up = _padded(shape, seed=1)
+    want = apply_taps_padded(up, _taps(kind))
+    got = apply_taps_pallas(up, _taps(kind), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_interpret_bf16_storage_fp32_compute():
+    up = _padded((8, 16, 16), seed=2).astype(jnp.bfloat16)
+    want = apply_taps_padded(
+        up, _taps("7pt"), compute_dtype=jnp.float32, out_dtype=jnp.bfloat16
+    )
+    got = apply_taps_pallas(
+        up, _taps("7pt"), compute_dtype=jnp.float32, out_dtype=jnp.bfloat16,
+        interpret=True,
+    )
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32),
+        np.asarray(want, dtype=np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+def test_choose_blocks_divides_and_fits():
+    for shape in [(8, 8, 8), (128, 128, 128), (64, 256, 512), (512, 64, 1024)]:
+        blocks = choose_blocks(shape)
+        assert blocks is not None, shape
+        bx, by = blocks
+        assert shape[0] % bx == 0 and shape[1] % by == 0
+
+
+def test_pallas_supported_gating():
+    cfg = SolverConfig(
+        grid=GridConfig.cube(16), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="pallas",
+    )
+    ok, why = pallas_supported(cfg)
+    if ON_TPU:
+        assert ok, why
+    else:
+        assert not ok and "platform" in why
+
+
+@pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_compiled_matches_jnp_on_tpu(kind):
+    up = _padded((16, 32, 128), seed=3)
+    want = apply_taps_padded(up, _taps(kind))
+    got = apply_taps_pallas(up, _taps(kind))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+@pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
+def test_compiled_bf16_on_tpu():
+    up = _padded((16, 32, 128), seed=4).astype(jnp.bfloat16)
+    want = apply_taps_padded(
+        up, _taps("7pt"), compute_dtype=jnp.float32, out_dtype=jnp.bfloat16
+    )
+    got = apply_taps_pallas(
+        up, _taps("7pt"), compute_dtype=jnp.float32, out_dtype=jnp.bfloat16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32),
+        np.asarray(want, dtype=np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+@pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
+def test_solver_pallas_backend_end_to_end():
+    from heat3d_tpu.core import golden
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="pallas",
+    )
+    solver = HeatSolver3D(cfg)
+    u = solver.init_state("gaussian")
+    u = solver.run(u, 5)
+    want = golden.run(
+        golden.gaussian_init(cfg.grid.shape).astype(np.float64),
+        cfg.grid, cfg.stencil, 5,
+    )
+    np.testing.assert_allclose(solver.gather(u), want, rtol=1e-4, atol=1e-5)
