@@ -15,15 +15,14 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import jax
-import jax.numpy as jnp
 
 A100_BASELINE_GCELLS_PER_CHIP = 100.0
 
 
 def main() -> int:
+    from heat3d_tpu.bench.harness import bench_throughput
     from heat3d_tpu.core.config import (
         GridConfig,
         MeshConfig,
@@ -32,7 +31,6 @@ def main() -> int:
         SolverConfig,
         StencilConfig,
     )
-    from heat3d_tpu.models.heat3d import HeatSolver3D
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -49,17 +47,9 @@ def main() -> int:
         run=RunConfig(num_steps=steps),
         backend=backend,
     )
-    solver = HeatSolver3D(cfg)
-    u = solver.init_state("hot-cube")
-
-    # Warmup: compile the multistep executable and run a few steps.
-    u = jax.block_until_ready(solver.run(u, 3))
-
-    t0 = time.perf_counter()
-    u = jax.block_until_ready(solver.run(u, steps))
-    elapsed = time.perf_counter() - t0
-
-    gcells = cfg.grid.num_cells * steps / elapsed / 1e9
+    r = bench_throughput(cfg, steps=steps, warmup=1, repeats=3)
+    gcells = r["gcell_per_sec_per_chip"]
+    elapsed = r["seconds_best"]
     print(
         json.dumps(
             {

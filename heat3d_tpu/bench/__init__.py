@@ -1,0 +1,12 @@
+"""Benchmark harness — the judged-metric producer (SURVEY.md §2 C9, §3.5).
+
+Two microbenchmarks mirror the reference's headline numbers:
+  * throughput: Gcell-updates/sec/chip of the full time loop
+  * halo: p50/p95 latency of a jitted exchange-only program
+"""
+
+from heat3d_tpu.bench.harness import (  # noqa: F401
+    bench_halo,
+    bench_throughput,
+    run_suite,
+)

@@ -1,0 +1,53 @@
+"""CLI: ``python -m heat3d_tpu.bench`` — run the judged benchmark matrix.
+
+Each BASELINE.md matrix row is expressible: --grid/--mesh/--stencil/--dtype
+mirror the solver CLI; --profile-dir wraps the run in a jax.profiler trace
+(SURVEY.md §5 'Tracing / profiling').
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+
+from heat3d_tpu.cli import build_parser, config_from_args
+from heat3d_tpu.bench.harness import bench_halo, bench_throughput, run_suite
+
+
+def main(argv=None) -> int:
+    base = build_parser()
+    p = argparse.ArgumentParser(
+        prog="heat3d-bench", parents=[base], add_help=False, conflict_handler="resolve"
+    )
+    p.add_argument("--bench", choices=["all", "throughput", "halo"], default="all")
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--iters", type=int, default=30, help="halo timing iterations")
+    args = p.parse_args(argv)
+    cfg = config_from_args(args)
+
+    profile_cm = None
+    if args.profile_dir:
+        profile_cm = jax.profiler.trace(args.profile_dir)
+        profile_cm.__enter__()
+    try:
+        if args.bench == "throughput":
+            import json
+
+            print(json.dumps(bench_throughput(cfg, steps=args.steps,
+                                              repeats=args.repeats)))
+        elif args.bench == "halo":
+            import json
+
+            print(json.dumps(bench_halo(cfg, iters=args.iters)))
+        else:
+            run_suite([cfg], steps=args.steps)
+    finally:
+        if profile_cm is not None:
+            profile_cm.__exit__(None, None, None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

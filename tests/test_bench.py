@@ -1,0 +1,53 @@
+"""Benchmark-as-test tier (SURVEY.md §4): the harness runs on tiny grids
+and emits well-formed results."""
+
+import json
+import subprocess
+import sys
+
+from heat3d_tpu.bench.harness import bench_halo, bench_throughput
+from heat3d_tpu.core.config import GridConfig, MeshConfig, SolverConfig
+
+
+def tiny_cfg():
+    return SolverConfig(
+        grid=GridConfig.cube(16), mesh=MeshConfig(shape=(1, 1, 1)), backend="jnp"
+    )
+
+
+def test_throughput_result_shape():
+    r = bench_throughput(tiny_cfg(), steps=3, warmup=1, repeats=2)
+    assert r["gcell_per_sec"] > 0
+    assert r["gcell_per_sec_per_chip"] == r["gcell_per_sec"]
+    assert len(r["seconds_all"]) == 2
+    json.dumps(r)
+
+
+def test_halo_result_shape():
+    r = bench_halo(tiny_cfg(), iters=5, warmup=1)
+    assert r["p50_us"] > 0
+    assert r["p95_us"] >= r["p50_us"] >= r["min_us"] * 0.99
+    # 3 faces x 2 directions of a 16^3 local block, fp32
+    assert r["halo_bytes_per_device"] == 2 * 3 * 16 * 16 * 4
+    json.dumps(r)
+
+
+def test_root_bench_emits_one_json_line():
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env={
+            **__import__("os").environ,
+            "HEAT3D_BENCH_GRID": "16",
+            "HEAT3D_BENCH_STEPS": "2",
+        },
+        cwd=__import__("os").path.dirname(
+            __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+        ),
+    )
+    assert out.returncode == 0, out.stderr
+    line = out.stdout.strip().splitlines()[-1]
+    d = json.loads(line)
+    assert set(d) >= {"metric", "value", "unit", "vs_baseline"}
