@@ -47,11 +47,36 @@ def run(
     grid: GridConfig,
     stencil: StencilConfig,
     num_steps: int,
+    impl: str = "auto",
 ) -> np.ndarray:
-    """num_steps golden updates; float64 throughout."""
+    """num_steps golden updates; float64 throughout.
+
+    impl: 'numpy' (pure NumPy, always available), 'native' (the OpenMP C++
+    stepper in heat3d_tpu.native — the compiled-host-code analogue of the
+    reference's serial path, ~100x faster at large grids), or 'auto'
+    (native when built, else numpy). Both produce identical float64 math;
+    tests/test_native.py holds them to tight agreement.
+    """
     taps = stencil_taps(
         STENCILS[stencil.kind], grid.alpha, grid.effective_dt(), grid.spacing
     )
+    if impl not in ("auto", "numpy", "native"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if impl in ("auto", "native"):
+        from heat3d_tpu import native
+
+        if native.available():
+            return native.run(
+                u0,
+                taps,
+                num_steps,
+                periodic=stencil.bc is BoundaryCondition.PERIODIC,
+                bc_value=stencil.bc_value,
+            )
+        if impl == "native":
+            raise RuntimeError(
+                f"native stepper unavailable: {native.build_error()}"
+            )
     u = u0.astype(np.float64)
     for _ in range(num_steps):
         u = step(u, taps, stencil.bc, stencil.bc_value)

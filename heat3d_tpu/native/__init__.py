@@ -1,0 +1,132 @@
+"""ctypes loader for the native C++ reference stepper.
+
+Builds ``heat3d_native.cpp`` with g++ -O3 -fopenmp on first use (cached
+next to the source; pybind11 is unavailable in this image, so the binding
+is plain ctypes — SURVEY.md §2 C10/C11). Degrades gracefully: if no
+compiler or the build fails, ``available()`` is False and callers (the
+golden model) fall back to NumPy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "heat3d_native.cpp")
+_SO = os.path.join(_HERE, "_heat3d_native.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library. Returns an error string or None."""
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"g++ launch failed: {e}"
+    if proc.returncode != 0:
+        return f"g++ failed: {proc.stderr[-2000:]}"
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            err = _build()
+            if err:
+                _build_error = err
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            _build_error = f"dlopen failed: {e}"
+            return None
+        if lib.heat3d_native_abi_version() != _ABI_VERSION:
+            _build_error = "ABI version mismatch; delete the stale .so"
+            return None
+        lib.heat3d_run_f64.restype = ctypes.c_int
+        lib.heat3d_run_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int, ctypes.c_double,
+        ]
+        lib.heat3d_diff_sumsq_f64.restype = ctypes.c_double
+        lib.heat3d_diff_sumsq_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def run(
+    u0: np.ndarray,
+    taps: np.ndarray,
+    num_steps: int,
+    periodic: bool,
+    bc_value: float = 0.0,
+) -> np.ndarray:
+    """num_steps explicit-Euler updates of interior field u0 (float64 copy
+    returned; u0 untouched)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native stepper unavailable: {_build_error}")
+    u = np.ascontiguousarray(u0, dtype=np.float64).copy()
+    t = np.ascontiguousarray(taps, dtype=np.float64)
+    if u.ndim != 3 or t.shape != (3, 3, 3):
+        raise ValueError(f"bad shapes: u {u.shape}, taps {t.shape}")
+    rc = lib.heat3d_run_f64(
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        *map(ctypes.c_int64, u.shape),
+        t.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(num_steps),
+        ctypes.c_int(1 if periodic else 0),
+        ctypes.c_double(bc_value),
+    )
+    if rc != 0:
+        raise RuntimeError(f"heat3d_run_f64 returned {rc}")
+    return u
+
+
+def diff_sumsq(a: np.ndarray, b: np.ndarray) -> float:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native stepper unavailable: {_build_error}")
+    aa = np.ascontiguousarray(a, dtype=np.float64)
+    bb = np.ascontiguousarray(b, dtype=np.float64)
+    if aa.size != bb.size:
+        raise ValueError("size mismatch")
+    return float(
+        lib.heat3d_diff_sumsq_f64(
+            aa.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            bb.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(aa.size),
+        )
+    )

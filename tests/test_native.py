@@ -1,0 +1,69 @@
+"""Native C++ stepper vs NumPy golden — the two serial oracles must agree.
+
+The native stepper is the compiled-host-code analogue of the reference's
+serial CPU path (SURVEY.md §2 C10); both run float64, so agreement is at
+rounding-order scale, not truncation scale.
+"""
+
+import numpy as np
+import pytest
+
+from heat3d_tpu import native
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.config import BoundaryCondition, GridConfig, StencilConfig
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native build failed: {native.build_error()}"
+)
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize(
+    "bc,bcv",
+    [
+        (BoundaryCondition.DIRICHLET, 0.0),
+        (BoundaryCondition.DIRICHLET, 2.5),
+        (BoundaryCondition.PERIODIC, 0.0),
+    ],
+)
+def test_native_matches_numpy(kind, bc, bcv):
+    grid = GridConfig(shape=(9, 11, 13), spacing=(1.0, 1.0, 1.0))
+    stencil = StencilConfig(kind=kind, bc=bc, bc_value=bcv)
+    u0 = golden.random_init((9, 11, 13), seed=5).astype(np.float64)
+    a = golden.run(u0, grid, stencil, 4, impl="numpy")
+    b = golden.run(u0, grid, stencil, 4, impl="native")
+    np.testing.assert_allclose(a, b, rtol=1e-13, atol=1e-13)
+
+
+def test_native_anisotropic_spacing():
+    grid = GridConfig(shape=(8, 8, 8), spacing=(1.0, 0.5, 2.0))
+    stencil = StencilConfig(kind="7pt")
+    u0 = golden.gaussian_init((8, 8, 8)).astype(np.float64)
+    a = golden.run(u0, grid, stencil, 3, impl="numpy")
+    b = golden.run(u0, grid, stencil, 3, impl="native")
+    np.testing.assert_allclose(a, b, rtol=1e-13, atol=1e-13)
+
+
+def test_native_zero_steps_identity():
+    grid = GridConfig(shape=(4, 4, 4))
+    u0 = golden.random_init((4, 4, 4), seed=1).astype(np.float64)
+    out = golden.run(u0, grid, StencilConfig(), 0, impl="native")
+    np.testing.assert_array_equal(out, u0)
+
+
+def test_diff_sumsq_matches_numpy():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((32, 32, 32))
+    b = rng.standard_normal((32, 32, 32))
+    want = float(np.sum((a - b) ** 2))
+    got = native.diff_sumsq(a, b)
+    assert got == pytest.approx(want, rel=1e-12)
+
+
+def test_auto_prefers_native_and_agrees():
+    grid = GridConfig(shape=(8, 8, 8))
+    stencil = StencilConfig(kind="27pt", bc=BoundaryCondition.PERIODIC)
+    u0 = golden.hot_cube_init((8, 8, 8)).astype(np.float64)
+    auto = golden.run(u0, grid, stencil, 5, impl="auto")
+    ref = golden.run(u0, grid, stencil, 5, impl="numpy")
+    np.testing.assert_allclose(auto, ref, rtol=1e-13, atol=1e-13)
