@@ -35,6 +35,7 @@ from heat3d_tpu.core.config import (
 )
 from heat3d_tpu.parallel import distributed
 from heat3d_tpu.utils.logging import emit_json, get_logger
+from heat3d_tpu.utils.timing import force_sync
 
 log = get_logger("heat3d.cli")
 
@@ -171,7 +172,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         u = solver.run(u, 0)
         jax.block_until_ready(solver.step_with_residual(_dummy()))
-    jax.block_until_ready(u)
+    # force_sync, not block_until_ready: the latter returns before execution
+    # finishes under the axon remote tunnel (utils.timing docstring)
+    force_sync(u)
 
     t0 = time.perf_counter()
     residual = None
@@ -218,7 +221,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 and done < total  # final checkpoint written below
             ):
                 solver.save_checkpoint(args.checkpoint, u, start_step + done)
-    jax.block_until_ready(u)
+    force_sync(u)
     elapsed = time.perf_counter() - t0
     steps_done = start_step + done
 

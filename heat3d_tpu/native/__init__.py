@@ -28,17 +28,34 @@ _build_error: Optional[str] = None
 
 
 def _build() -> Optional[str]:
-    """Compile the shared library. Returns an error string or None."""
-    cmd = [
-        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
-        "-o", _SO, _SRC,
-    ]
+    """Compile the shared library. Returns an error string or None.
+
+    Compiles to a pid-unique temp path and os.replace()s into place so
+    concurrent builder processes (pytest-xdist workers, multi-process ranks)
+    never dlopen a half-written file; an fcntl lock serializes the compile
+    itself. No -march=native: the cached .so must stay valid if the tree is
+    copied to another machine, and the stepper is bandwidth-bound anyway."""
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-o", tmp, _SRC]
+    lock_path = _SO + ".lock"
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        import fcntl
+
+        with open(lock_path, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                # another process may have finished the build while we waited
+                if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+                    return None
+                proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+                if proc.returncode != 0:
+                    return f"g++ failed: {proc.stderr[-2000:]}"
+                os.replace(tmp, _SO)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
     except (OSError, subprocess.TimeoutExpired) as e:
         return f"g++ launch failed: {e}"
-    if proc.returncode != 0:
-        return f"g++ failed: {proc.stderr[-2000:]}"
     return None
 
 

@@ -68,12 +68,18 @@ def choose_blocks(
     local_shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
 ) -> Optional[Tuple[int, int]]:
     """Pick (bx, by) output-tile sizes for a (nx, ny, nz) local block, or
-    None if no divisor combination fits the VMEM budget."""
+    None if no divisor combination fits the VMEM budget.
+
+    Mosaic constrains the *trailing two* dims of every block: the overlapped
+    input window (bx+2, by+2, nz+2) must have (by+2) % 8 == 0 or by == ny
+    (full-extent windows are exempt), and the z window is always full-extent.
+    Divisors of power-of-two extents can never satisfy (by+2) % 8 == 0, so
+    by == ny is the common case and tiling happens along x (a leading dim,
+    unconstrained)."""
     nx, ny, nz = local_shape
-    for by in _divisors_desc(ny, 256):
-        # prefer sublane-aligned y tiles when the extent allows it
-        if by % _SUBLANE and ny % _SUBLANE == 0 and by < ny:
-            continue
+    candidates = [by for by in _divisors_desc(ny, 256) if (by + 2) % _SUBLANE == 0]
+    candidates.insert(0, ny)  # full-extent y window: always legal, zero y-overlap
+    for by in candidates:
         for bx in _divisors_desc(nx, 8):
             if _vmem_step_bytes(bx, by, nz, in_itemsize, out_itemsize) <= _VMEM_STEP_BUDGET:
                 return bx, by
@@ -151,7 +157,7 @@ def apply_taps_pallas(
         grid=(nx // bx, ny // by),
         in_specs=[
             pl.BlockSpec(
-                (_Element(bx + 2), _Element(by + 2), nz + 2),
+                (_Element(bx + 2), _Element(by + 2), _Element(nz + 2)),
                 lambda i, j: (i * bx, j * by, 0),
             )
         ],

@@ -70,6 +70,10 @@ def make_step_fn(
     spec = P(*cfg.mesh.axis_names)
     axes = cfg.mesh.axis_names
 
+    # check_vma=False: pallas_call inside shard_map would otherwise require a
+    # `vma` annotation on its out_shape (jax 0.9), and the kernel is built
+    # mesh-agnostic. The unmapped residual out_spec stays sound: psum over all
+    # mesh axes makes it replicated by construction.
     if with_residual:
 
         def local(u_local):
@@ -78,12 +82,16 @@ def make_step_fn(
             r = lax.psum(r, axes)  # MPI_Allreduce analogue (SURVEY.md §3.3)
             return u_new, r
 
-        return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=(spec, P()))
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=spec, out_specs=(spec, P()), check_vma=False
+        )
 
     def local(u_local):
         return _local_step(u_local, taps, cfg, compute_padded)
 
-    return jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
 
 
 def make_multistep_fn(

@@ -2,7 +2,13 @@
 
 Reference parity (SURVEY.md §2 C9, §3.5): the reference brackets its loop
 with MPI_Barrier + MPI_Wtime. The TPU equivalent of the barrier+Wtime pair
-is ``jax.block_until_ready`` around ``time.perf_counter``.
+is a device->host readback around ``time.perf_counter``.
+
+``jax.block_until_ready`` is NOT sufficient on every platform: under the
+remote-tunnel (axon) PJRT plugin it returns before execution finishes
+(verified: a 50-step 512^3 run "completes" in 0.1 ms). ``force_sync``
+instead reads one element of every array leaf back to the host, which
+cannot complete until the producing computation has.
 """
 
 from __future__ import annotations
@@ -11,18 +17,47 @@ import time
 from typing import List
 
 import jax
+import numpy as np
+
+
+def force_sync(x) -> None:
+    """Barrier that works on async-dispatch platforms: device->host readback
+    of one element of every array leaf of ``x``."""
+    for leaf in jax.tree.leaves(x):
+        if isinstance(leaf, jax.Array):
+            shard = leaf.addressable_data(0)
+            np.asarray(shard[(0,) * shard.ndim])
+
+
+def sync_overhead(probe=None, samples: int = 5) -> float:
+    """Measured cost of one ``force_sync`` round trip (dispatch + transfer
+    latency), to subtract from timings. ~75 ms over the axon tunnel, ~us
+    locally."""
+    x = probe if probe is not None else jax.numpy.zeros((8, 128))
+    force_sync(x)
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        force_sync(x)
+        times.append(time.perf_counter() - t0)
+    return min(times)
 
 
 def time_fn(fn, *args, warmup: int = 1, iters: int = 5) -> List[float]:
-    """Per-call wall times of ``fn(*args)`` with block_until_ready, after
+    """Per-call wall times of ``fn(*args)`` with forced device sync, after
     ``warmup`` excluded calls (compile + cache warm). Returns all iter
-    times so callers can take p50/p95 (the halo-latency metric)."""
+    times so callers can take p50/p95 (the halo-latency metric).
+
+    Note: each sample includes one host round trip; on high-RTT platforms
+    prefer amortizing many device-side iterations per sample (as
+    bench.harness.bench_throughput does with its multi-step compiled loop)
+    and subtract ``sync_overhead()`` from each sample."""
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        force_sync(fn(*args))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        force_sync(fn(*args))
         times.append(time.perf_counter() - t0)
     return times
 
