@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", choices=["fp32", "bf16"], default="fp32",
                    help="field storage dtype; residual always accumulates fp32")
     p.add_argument("--backend", choices=["auto", "jnp", "pallas"], default="auto")
+    p.add_argument("--overlap", action="store_true",
+                   help="overlap halo exchange with interior compute "
+                   "(interior/boundary split step)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--init", default="hot-cube", help="hot-cube | gaussian | random")
     p.add_argument("--seed", type=int, default=0)
@@ -119,6 +122,7 @@ def config_from_args(args) -> SolverConfig:
             profile_dir=args.profile_dir,
         ),
         backend=args.backend,
+        overlap=args.overlap,
     )
 
 

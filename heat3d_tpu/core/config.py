@@ -197,6 +197,11 @@ class SolverConfig:
     precision: Precision = Precision()
     run: RunConfig = RunConfig()
     backend: str = "auto"  # 'jnp' | 'pallas' | 'auto' (pallas on TPU else jnp)
+    # Split each step into interior + boundary-shell updates so XLA's async
+    # collectives overlap the halo ppermutes with the interior sweep — the
+    # TPU analogue of the reference class's two-stream interior/boundary
+    # overlap (SURVEY.md §3.2, §7.3 item 2). Needs local blocks >= 3 per axis.
+    overlap: bool = False
 
     def __post_init__(self):
         for g, p, name in zip(self.grid.shape, self.mesh.shape, "xyz"):

@@ -2,14 +2,14 @@
 
 Reference parity (SURVEY.md §2 C1): the reference's CUDA ``__global__``
 Jacobi kernel (one thread per cell, 3D thread blocks). The TPU-native
-formulation tiles the ghost-padded local block over a 2D Pallas grid of
-(x, y) output tiles; each program holds a halo-overlapped input window in
-VMEM — ``Element``-indexed BlockSpecs give the overlapping reads, Mosaic's
-grid pipeline double-buffers the HBM->VMEM streaming — and evaluates the
-3x3x3 taps as statically-unrolled shifted-slice FMAs on the VPU. The z
-axis stays whole: it is the lane dimension, so ±1 shifts along it are
-cheap in-register lane shifts, and the 8x128 (fp32) tile constraint is
-respected by keeping (y, z) as the trailing dims.
+formulation tiles the ghost-padded local block over a 1D Pallas grid of
+x-slabs; each program holds a halo-overlapped input window in VMEM —
+``Element``-indexed BlockSpecs give the overlapping reads, Mosaic's grid
+pipeline double-buffers the HBM->VMEM streaming — and evaluates the
+3x3x3 taps as statically-unrolled shifted-slice FMAs on the VPU. The y
+and z axes stay whole: they are the (sublane, lane) dims, where Mosaic
+requires provably-aligned window offsets (see choose_blocks), and ±1
+shifts along them are cheap in-register sublane/lane shifts.
 
 The kernel computes in ``compute_dtype`` (fp32 even for bf16 storage by
 default — BASELINE.json config 5's "bf16 stencil + fp32 residual" policy)
@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 try:  # Element-indexed (overlapping-window) block dims
     from jax._src.pallas.core import Element as _Element
@@ -68,41 +69,146 @@ def choose_blocks(
     local_shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
 ) -> Optional[Tuple[int, int]]:
     """Pick (bx, by) output-tile sizes for a (nx, ny, nz) local block, or
-    None if no divisor combination fits the VMEM budget.
+    None if no x-tiling fits the VMEM budget. ``by`` is always ``ny``.
 
-    Mosaic constrains the *trailing two* dims of every block: the overlapped
-    input window (bx+2, by+2, nz+2) must have (by+2) % 8 == 0 or by == ny
-    (full-extent windows are exempt), and the z window is always full-extent.
-    Divisors of power-of-two extents can never satisfy (by+2) % 8 == 0, so
-    by == ny is the common case and tiling happens along x (a leading dim,
-    unconstrained)."""
+    Constraints established empirically on v5-lite hardware: the trailing two
+    dims of the overlapped (Element) input window must be 8/128-divisible or
+    full-extent (Pallas lowering check), AND Mosaic must prove the sublane
+    window *offset* divisible by 8. A tiled y can never satisfy both —
+    (by+2) % 8 == 0 and by % 8 == 0 are mutually exclusive — so the y window
+    is always full-extent with a literal-0 offset (trivially provable; this
+    also covers odd ny such as the 62^3 overlap-step interior). Tiling
+    therefore happens only along x, the untiled leading dim, where offsets
+    are unconstrained."""
     nx, ny, nz = local_shape
-    candidates = [by for by in _divisors_desc(ny, 256) if (by + 2) % _SUBLANE == 0]
-    candidates.insert(0, ny)  # full-extent y window: always legal, zero y-overlap
-    for by in candidates:
-        for bx in _divisors_desc(nx, 8):
-            if _vmem_step_bytes(bx, by, nz, in_itemsize, out_itemsize) <= _VMEM_STEP_BUDGET:
-                return bx, by
+    for bx in _divisors_desc(nx, 256):
+        if _vmem_step_bytes(bx, ny, nz, in_itemsize, out_itemsize) <= _VMEM_STEP_BUDGET:
+            return bx, ny
     return None
 
 
 def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
     """Can the Pallas kernel run this config's local blocks?"""
-    if _Element is None:
-        return False, "pallas Element block dims unavailable in this jax"
     platform = jax.devices()[0].platform
     if platform != "tpu":
         return False, f"platform is {platform!r}, kernel targets TPU"
     if jnp.dtype(cfg.precision.storage).itemsize not in (2, 4):
         return False, f"unsupported storage dtype {cfg.precision.storage}"
-    blocks = choose_blocks(
-        cfg.local_shape,
-        jnp.dtype(cfg.precision.storage).itemsize,
-        jnp.dtype(cfg.precision.storage).itemsize,
-    )
+    itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    if stream_supported(cfg.local_shape, itemsize, itemsize):
+        return True, ""  # streaming kernel: no Element windows needed
+    if _Element is None:
+        return False, "pallas Element block dims unavailable in this jax"
+    blocks = choose_blocks(cfg.local_shape, itemsize, itemsize)
     if blocks is None:
-        return False, f"no block tiling of {cfg.local_shape} fits VMEM"
+        return False, f"no streaming ring or block tiling of {cfg.local_shape} fits VMEM"
     return True, ""
+
+
+def _stream_vmem_bytes(
+    shape: Tuple[int, int, int], in_itemsize: int, out_itemsize: int
+) -> int:
+    """VMEM footprint of the streaming kernel: a 3-plane ring buffer plus
+    the double-buffered in/out plane pipeline, with TPU tile padding."""
+    ny, nz = shape[1], shape[2]
+    plane_in = _round_up(ny + 2, _SUBLANE) * _round_up(nz + 2, _LANE) * in_itemsize
+    plane_out = _round_up(ny, _SUBLANE) * _round_up(nz, _LANE) * out_itemsize
+    return 3 * plane_in + 2 * plane_in + 2 * plane_out
+
+
+# Streaming kernel budget: ring + pipeline must leave Mosaic headroom in the
+# ~16 MB VMEM.
+_STREAM_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def stream_supported(
+    shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
+) -> bool:
+    return _stream_vmem_bytes(shape, in_itemsize, out_itemsize) <= _STREAM_VMEM_BUDGET
+
+
+def _stream_kernel(in_ref, out_ref, scratch, *, taps_by_di, ny, nz,
+                   compute_dtype, out_dtype):
+    """Streaming x-plane stencil: grid step i loads padded plane i into a
+    3-slot VMEM ring; once 3 planes are resident, emits output plane i-2.
+
+    Every HBM plane is fetched exactly once (the windowed kernel re-fetches
+    overlap planes), which matters when bandwidth is the roofline. Slot
+    arithmetic is unrolled into three pl.when branches so all scratch
+    indices are static.
+    """
+    i = pl.program_id(0)
+    slot = jax.lax.rem(i, 3)
+    for k in range(3):
+
+        @pl.when(slot == k)
+        def _store(k=k):
+            scratch[k] = in_ref[0]
+
+    for k in range(3):
+
+        @pl.when(jnp.logical_and(i >= 2, slot == k))
+        def _compute(k=k):
+            # i % 3 == k  =>  padded planes (i-2, i-1, i) live in slots
+            # ((k+1)%3, (k+2)%3, k).
+            planes = {
+                -1: scratch[(k + 1) % 3].astype(compute_dtype),
+                0: scratch[(k + 2) % 3].astype(compute_dtype),
+                1: scratch[k].astype(compute_dtype),
+            }
+            acc = None
+            for di, dj, dk, w in taps_by_di:
+                sl = planes[di][1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+                term = compute_dtype(w) * sl
+                acc = term if acc is None else acc + term
+            out_ref[0] = acc.astype(out_dtype)
+
+
+def apply_taps_pallas_stream(
+    up: jax.Array,
+    taps: np.ndarray,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Streaming-form Pallas stencil: ghost-padded (nx+2, ny+2, nz+2) block
+    in, (nx, ny, nz) interior update out. One grid step per padded x-plane;
+    output plane i-2 is emitted at step i (steps 0-1 prime the ring)."""
+    nxp, nyp, nzp = up.shape
+    nx, ny, nz = nxp - 2, nyp - 2, nzp - 2
+    out_dtype = out_dtype or up.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+
+    kernel = functools.partial(
+        _stream_kernel,
+        taps_by_di=flat,
+        ny=ny,
+        nz=nz,
+        compute_dtype=compute_dtype,
+        out_dtype=jnp.dtype(out_dtype),
+    )
+    flops_per_cell = 2 * len(flat)
+    return pl.pallas_call(
+        kernel,
+        grid=(nxp,),
+        in_specs=[pl.BlockSpec((1, nyp, nzp), lambda i: (i, 0, 0))],
+        # Steps 0-1 park on output plane 0; step 2 overwrites it with the
+        # real value before the block is ever flushed (the index only
+        # changes at step 3).
+        out_specs=pl.BlockSpec(
+            (1, ny, nz), lambda i: (jnp.maximum(i - 2, 0), 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+        scratch_shapes=[pltpu.VMEM((3, nyp, nzp), up.dtype)],
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_cell * nx * ny * nz,
+            bytes_accessed=nxp * nyp * nzp * up.dtype.itemsize
+            + nx * ny * nz * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(up)
 
 
 def _stencil_kernel(in_ref, out_ref, *, taps, bx, by, nz, compute_dtype, out_dtype):
@@ -130,9 +236,19 @@ def apply_taps_pallas(
     interpret: bool = False,
 ) -> jax.Array:
     """Pallas analogue of ops.stencil_jnp.apply_taps_padded: ghost-padded
-    (nx+2, ny+2, nz+2) block in, (nx, ny, nz) interior update out."""
+    (nx+2, ny+2, nz+2) block in, (nx, ny, nz) interior update out.
+
+    Dispatches to the streaming ring kernel (every HBM plane fetched once)
+    when its VMEM ring fits, else the windowed x-slab kernel."""
     nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
     out_dtype = out_dtype or up.dtype
+    if stream_supported(
+        (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+    ):
+        return apply_taps_pallas_stream(
+            up, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
+            interpret=interpret,
+        )
     compute_dtype = jnp.dtype(compute_dtype).type
     blocks = choose_blocks(
         (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize
@@ -152,16 +268,18 @@ def apply_taps_pallas(
         out_dtype=jnp.dtype(out_dtype),
     )
     flops_per_cell = 2 * len(tap_list)
+    # y/z windows are full-extent with literal-0 offsets (see choose_blocks);
+    # the grid walks x only.
     return pl.pallas_call(
         kernel,
-        grid=(nx // bx, ny // by),
+        grid=(nx // bx,),
         in_specs=[
             pl.BlockSpec(
                 (_Element(bx + 2), _Element(by + 2), _Element(nz + 2)),
-                lambda i, j: (i * bx, j * by, 0),
+                lambda i: (i * bx, 0, 0),
             )
         ],
-        out_specs=pl.BlockSpec((bx, by, nz), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((bx, by, nz), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
         cost_estimate=pl.CostEstimate(
             flops=flops_per_cell * nx * ny * nz,

@@ -57,6 +57,55 @@ def _local_step(
     )
 
 
+def _local_step_overlap(
+    u_local: jax.Array,
+    taps: np.ndarray,
+    cfg: SolverConfig,
+    compute_padded: LocalCompute,
+) -> jax.Array:
+    """Comm/compute-overlapped local step (SURVEY.md §3.2 "optimized variants
+    ... interior-update kernel on one CUDA stream while faces exchange on
+    another, then boundary-update").
+
+    The interior cells (local indices 1..n-2 per axis) read only local data,
+    so their update carries **no data dependence on the ppermutes** — XLA's
+    async collectives (collective-permute-start/done) can run the ICI
+    transfers concurrently with the interior sweep. Only the 1-cell boundary
+    shell waits for ghosts. The assembled result is arithmetically identical
+    to the unsplit step (same taps, same op order per cell).
+    """
+    nx, ny, nz = u_local.shape
+    compute_dtype = jnp.dtype(cfg.precision.compute)
+    out_dtype = jnp.dtype(cfg.precision.storage)
+
+    # Ghost exchange: the ppermutes this step overlaps with.
+    up = exchange_halo(u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value)
+
+    # Interior update from the local block alone (u_local acts as its own
+    # ghost-padded input for the (nx-2, ny-2, nz-2) interior) — the bulk of
+    # the FLOPs, scheduled while faces are in flight.
+    interior = compute_padded(
+        u_local, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+    )
+    out = jnp.zeros((nx, ny, nz), out_dtype)
+    out = lax.dynamic_update_slice(out, interior, (1, 1, 1))
+
+    # Boundary shell: six thickness-1 faces from the ghost-padded block.
+    # Edge/corner cells land in two or three face slabs; each computes the
+    # identical value, so overlapping writes are benign. Faces are thin VPU
+    # work — always the jnp path, even when the interior runs Pallas.
+    for axis, n in enumerate((nx, ny, nz)):
+        for start, pos in ((0, 0), (n - 1, n - 1)):
+            slab = lax.slice_in_dim(up, start, start + 3, axis=axis)
+            face = apply_taps_padded(
+                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+            )
+            idx = [0, 0, 0]
+            idx[axis] = pos
+            out = lax.dynamic_update_slice(out, face, tuple(idx))
+    return out
+
+
 def make_step_fn(
     cfg: SolverConfig,
     mesh: Mesh,
@@ -69,6 +118,14 @@ def make_step_fn(
     taps = _solver_taps(cfg)
     spec = P(*cfg.mesh.axis_names)
     axes = cfg.mesh.axis_names
+    local_step = _local_step
+    if cfg.overlap:
+        if min(cfg.local_shape) < 3:
+            raise ValueError(
+                f"overlap=True needs local blocks >= 3 per axis to have an "
+                f"interior, got {cfg.local_shape}"
+            )
+        local_step = _local_step_overlap
 
     # check_vma=False: pallas_call inside shard_map would otherwise require a
     # `vma` annotation on its out_shape (jax 0.9), and the kernel is built
@@ -77,7 +134,7 @@ def make_step_fn(
     if with_residual:
 
         def local(u_local):
-            u_new = _local_step(u_local, taps, cfg, compute_padded)
+            u_new = local_step(u_local, taps, cfg, compute_padded)
             r = residual_sumsq(u_new, u_local, jnp.dtype(cfg.precision.residual))
             r = lax.psum(r, axes)  # MPI_Allreduce analogue (SURVEY.md §3.3)
             return u_new, r
@@ -87,7 +144,7 @@ def make_step_fn(
         )
 
     def local(u_local):
-        return _local_step(u_local, taps, cfg, compute_padded)
+        return local_step(u_local, taps, cfg, compute_padded)
 
     return jax.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False

@@ -69,6 +69,38 @@ def check_step_matches_single_device():
     print("step_matches_single_device OK")
 
 
+def check_overlap_step_distributed():
+    """Overlap (interior/boundary split) step == unsplit step on real
+    multi-device meshes — the correctness half of SURVEY.md §7.3 item 2."""
+    import dataclasses
+
+    # 24 along x so the (8,1,1) slab still leaves a >=3-cell local interior
+    grid = (24, 16, 16)
+    u_host = golden.random_init(grid, seed=13)
+    for mesh_shape in [(8, 1, 1), (2, 2, 2), (1, 2, 4)]:
+        for kind in ("7pt", "27pt"):
+            for bc in (BoundaryCondition.DIRICHLET, BoundaryCondition.PERIODIC):
+                cfg = SolverConfig(
+                    grid=GridConfig(shape=grid),
+                    stencil=StencilConfig(kind=kind, bc=bc),
+                    mesh=MeshConfig(shape=mesh_shape),
+                    backend="jnp",
+                )
+                mesh = build_mesh(cfg.mesh)
+                u = jax.device_put(
+                    jnp.asarray(u_host), field_sharding(mesh, cfg.mesh)
+                )
+                got = jax.jit(
+                    make_step_fn(dataclasses.replace(cfg, overlap=True), mesh)
+                )(u)
+                want = jax.jit(make_step_fn(cfg, mesh))(u)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+                    err_msg=f"mesh={mesh_shape} kind={kind} bc={bc}",
+                )
+    print("overlap_step_distributed OK")
+
+
 def check_bf16_distributed():
     grid = (16, 16, 16)
     cfg = SolverConfig(
@@ -209,6 +241,7 @@ def main():
     n = len(jax.devices())
     assert n == 8, f"expected 8 CPU devices, got {n} ({jax.devices()})"
     check_step_matches_single_device()
+    check_overlap_step_distributed()
     check_bf16_distributed()
     check_halo_ghost_identity()
     check_multistep_vs_golden()

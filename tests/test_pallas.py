@@ -115,6 +115,29 @@ def test_compiled_bf16_on_tpu():
 
 
 @pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
+def test_overlap_with_pallas_backend_on_tpu():
+    """overlap=True feeds the Pallas kernel an odd-extent (n-2)^3 interior —
+    must compile (full-extent y window, literal-0 offset) and match."""
+    import dataclasses
+
+    from heat3d_tpu.core import golden
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="pallas",
+    )
+    u0 = jnp.asarray(golden.random_init((32, 32, 32), seed=5))
+    a = HeatSolver3D(cfg)
+    b = HeatSolver3D(dataclasses.replace(cfg, overlap=True))
+    np.testing.assert_allclose(
+        np.asarray(a.step(jnp.array(u0))),
+        np.asarray(b.step(jnp.array(u0))),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+@pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
 def test_solver_pallas_backend_end_to_end():
     from heat3d_tpu.core import golden
     from heat3d_tpu.models.heat3d import HeatSolver3D
