@@ -107,7 +107,7 @@ def bench_halo(
         )
     )
     u = jax.device_put(
-        jnp.zeros(cfg.grid.shape, jnp.dtype(cfg.precision.storage)), sharding
+        jnp.zeros(cfg.padded_shape, jnp.dtype(cfg.precision.storage)), sharding
     )
     rtt = sync_overhead(probe=jnp.zeros((8, 128)))
     raw = time_fn(ex, u, warmup=warmup, iters=iters)

@@ -66,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap", action="store_true",
                    help="overlap halo exchange with interior compute "
                    "(interior/boundary split step)")
+    p.add_argument("--halo", choices=["ppermute", "dma"], default="ppermute",
+                   help="ghost-exchange transport: XLA collective-permute or "
+                   "Pallas remote-DMA kernels (TPU only)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--init", default="hot-cube", help="hot-cube | gaussian | random")
     p.add_argument("--seed", type=int, default=0)
@@ -123,6 +126,7 @@ def config_from_args(args) -> SolverConfig:
         ),
         backend=args.backend,
         overlap=args.overlap,
+        halo=args.halo,
     )
 
 
@@ -156,19 +160,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # (SURVEY.md §3.5: warmup iterations excluded). The dummy field is built
     # per-shard (zeros callback) so no process ever materializes the full
     # global array — same rule as init_state.
-    def _dummy():
-        return jax.make_array_from_callback(
-            cfg.grid.shape,
-            solver.sharding,
-            lambda idx: np.zeros(
-                tuple(
-                    (n if s.stop is None else s.stop)
-                    - (0 if s.start is None else s.start)
-                    for n, s in zip(cfg.grid.shape, idx)
-                ),
-                solver.storage_dtype,
-            ),
-        )
+    _dummy = solver.zeros_state
 
     if cfg.run.tolerance is not None:
         # while_loop cond is false at max_steps=0: compiles without advancing

@@ -202,16 +202,40 @@ class SolverConfig:
     # TPU analogue of the reference class's two-stream interior/boundary
     # overlap (SURVEY.md §3.2, §7.3 item 2). Needs local blocks >= 3 per axis.
     overlap: bool = False
+    # Ghost-exchange transport: 'ppermute' (XLA collective-permute, v1) or
+    # 'dma' (Pallas make_async_remote_copy kernels — the CUDA-aware/GPUDirect
+    # analogue, SURVEY.md §7.1 item 7; TPU only).
+    halo: str = "ppermute"
 
     def __post_init__(self):
-        for g, p, name in zip(self.grid.shape, self.mesh.shape, "xyz"):
-            if g % p:
-                raise ValueError(
-                    f"grid dim {name}={g} not divisible by mesh dim {p}; "
-                    "the distributed path requires divisible decompositions "
-                    "(SURVEY.md §7.3 item 4)"
-                )
+        if self.halo not in ("ppermute", "dma"):
+            raise ValueError(f"unknown halo transport {self.halo!r}")
+        if self.is_padded and self.stencil.bc is BoundaryCondition.PERIODIC:
+            raise ValueError(
+                f"grid {self.grid.shape} is not divisible by mesh "
+                f"{self.mesh.shape}: uneven decompositions are handled by "
+                "bc-value padding, which breaks periodic wrap adjacency — "
+                "use a divisible grid/mesh for periodic BCs "
+                "(SURVEY.md §7.3 item 4)"
+            )
+
+    @property
+    def padded_shape(self) -> Tuple[int, int, int]:
+        """Storage shape: the grid rounded up per axis to a mesh multiple.
+        Cells beyond ``grid.shape`` are inert padding pinned at bc_value,
+        which reproduces Dirichlet ghost semantics at the true boundary
+        (SURVEY.md §7.3 item 4; the reference class restricts itself to
+        divisible extents instead)."""
+        return tuple(  # type: ignore[return-value]
+            -(-g // p) * p for g, p in zip(self.grid.shape, self.mesh.shape)
+        )
+
+    @property
+    def is_padded(self) -> bool:
+        return self.padded_shape != self.grid.shape
 
     @property
     def local_shape(self) -> Tuple[int, int, int]:
-        return tuple(g // p for g, p in zip(self.grid.shape, self.mesh.shape))  # type: ignore[return-value]
+        return tuple(  # type: ignore[return-value]
+            s // p for s, p in zip(self.padded_shape, self.mesh.shape)
+        )

@@ -84,6 +84,14 @@ class HeatSolver3D:
     """
 
     def __init__(self, cfg: SolverConfig, devices=None):
+        if cfg.halo == "dma":
+            platform = jax.devices()[0].platform
+            if platform != "tpu":
+                raise ValueError(
+                    f"halo='dma' needs TPU hardware (Mosaic remote-DMA "
+                    f"kernels); platform is {platform!r} — use "
+                    "halo='ppermute'"
+                )
         self.cfg = cfg
         self.mesh = build_mesh(cfg.mesh, devices)
         self.sharding = field_sharding(self.mesh, cfg.mesh)
@@ -115,22 +123,65 @@ class HeatSolver3D:
         """Build the sharded initial field. A string selects a named
         initializer (core.golden.INITIALIZERS); an array is used directly.
         Materialization is per-shard via make_array_from_callback, so no
-        process ever holds the full 4096^3 field (SURVEY.md §2 C8)."""
-        shape = self.cfg.grid.shape
+        process ever holds the full 4096^3 field (SURVEY.md §2 C8).
+
+        Storage is ``cfg.padded_shape``; for uneven decompositions the
+        region beyond ``cfg.grid.shape`` is pinned at bc_value (see
+        parallel.step._pin_padding)."""
+        true_shape = self.cfg.grid.shape
         if isinstance(init, np.ndarray):
-            if init.shape != shape:
-                raise ValueError(f"init shape {init.shape} != grid {shape}")
+            if init.shape != true_shape:
+                raise ValueError(f"init shape {init.shape} != grid {true_shape}")
             arr = init.astype(self.storage_dtype)
-            return jax.make_array_from_callback(
-                shape, self.sharding, lambda idx: arr[idx]
+            return self._sharded_from_blocks(
+                lambda clipped: arr[clipped]
             )
         name, seed = init, self.cfg.run.seed
+        return self._sharded_from_blocks(
+            lambda clipped: golden.make_init_block(
+                name, true_shape, clipped, seed=seed
+            ).astype(self.storage_dtype)
+        )
+
+    def _sharded_from_blocks(self, true_block_fn) -> jax.Array:
+        """Build a sharded storage-layout field from a function evaluating
+        blocks of the TRUE grid. Regions beyond ``cfg.grid.shape`` (uneven-
+        decomposition padding) are filled with bc_value; each shard callback
+        clips its storage-index slices against the true extents."""
+        true_shape = self.cfg.grid.shape
+        storage_shape = self.cfg.padded_shape
+        bc_value = self.cfg.stencil.bc_value
 
         def cb(idx):
-            block = golden.make_init_block(name, shape, idx, seed=seed)
-            return block.astype(self.storage_dtype)
+            starts = [0 if s.start is None else s.start for s in idx]
+            stops = [
+                n if s.stop is None else s.stop
+                for s, n in zip(idx, storage_shape)
+            ]
+            block = np.full(
+                tuple(b - a for a, b in zip(starts, stops)),
+                bc_value,
+                self.storage_dtype,
+            )
+            clipped = tuple(
+                slice(a, min(b, g))
+                for a, b, g in zip(starts, stops, true_shape)
+            )
+            if all(c.stop > c.start for c in clipped):
+                local = tuple(slice(0, c.stop - c.start) for c in clipped)
+                block[local] = true_block_fn(clipped)
+            return block
 
-        return jax.make_array_from_callback(shape, self.sharding, cb)
+        return jax.make_array_from_callback(storage_shape, self.sharding, cb)
+
+    def zeros_state(self) -> jax.Array:
+        """An all-zero TRUE grid in storage layout (padding at bc_value) —
+        cheap warmup input for the donated executables."""
+        return self._sharded_from_blocks(
+            lambda clipped: np.zeros(
+                tuple(c.stop - c.start for c in clipped), self.storage_dtype
+            )
+        )
 
     # ---- stepping --------------------------------------------------------
 
@@ -154,8 +205,12 @@ class HeatSolver3D:
     # ---- IO --------------------------------------------------------------
 
     def gather(self, u: jax.Array) -> np.ndarray:
-        """Fetch the full field to host (small grids / tests only)."""
-        return np.asarray(jax.device_get(u))
+        """Fetch the full field to host (small grids / tests only), with any
+        uneven-decomposition storage padding stripped."""
+        full = np.asarray(jax.device_get(u))
+        if full.shape != self.cfg.grid.shape:
+            full = full[tuple(slice(0, g) for g in self.cfg.grid.shape)]
+        return full
 
     def save_checkpoint(self, path: str, u: jax.Array, step: int) -> None:
         ckpt.save(path, u, step, extra={"config": repr(self.cfg)})

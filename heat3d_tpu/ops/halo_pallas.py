@@ -1,0 +1,215 @@
+"""Pallas RDMA halo exchange — the CUDA-aware/GPUDirect analogue, v2 path.
+
+Reference parity (SURVEY.md §2 C2/C6, §5 "Distributed communication
+backend"): the reference's defining feature is CUDA-aware MPI — device
+pointers handed straight to MPI_Isend/Irecv so halo faces move NIC<->GPU
+with no host staging. The TPU-native moral equivalent is kernel-initiated
+inter-chip DMA: ``pltpu.make_async_remote_copy`` pushes my boundary face
+over ICI directly into the neighbor chip's ghost buffer, synchronized by
+DMA semaphores (SURVEY.md §7.1 item 7; the v1 path compiles
+``lax.ppermute`` to the same ICI transfers but through XLA's collective
+machinery).
+
+Exchange structure mirrors parallel.halo: one kernel per mesh axis,
+axis-ordered so edge/corner ghosts propagate (27-point stencil support);
+each kernel sends my low face to the low neighbor's high-ghost buffer and
+my high face to the high neighbor's low-ghost buffer, then waits for the
+symmetric receives. Non-periodic domain edges skip the send/recv and fill
+the ghost with the boundary value.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
+
+
+def _axis_exchange_kernel(
+    u_ref,
+    lo_ref,
+    hi_ref,
+    send_sem,
+    recv_sem,
+    *,
+    axis: int,
+    axis_name: str,
+    mesh_axes,
+    size: int,
+    periodic: bool,
+    bc_value: float,
+    use_barrier: bool = True,
+):
+    """Exchange ghost faces along one mesh axis via remote DMA.
+
+    Runs as one program instance per device (no grid). ``u_ref`` stays in
+    ANY/HBM — faces are DMA'd straight out of it, never staged through a
+    pack buffer (the reference needs explicit pack/unpack kernels because
+    MPI wants contiguous buffers; a TPU DMA descriptor handles the strided
+    face natively).
+    """
+    my = lax.axis_index(axis_name)
+    n = u_ref.shape[axis]
+    # Integer-index the face axis away: faces are 2D (ny, nz)/(nx, nz)/(nx, ny)
+    # refs, so the ghost buffers tile VMEM as (8, 128) planes instead of
+    # carrying a size-1 dim into the tiled trailing pair.
+    idx_lo = tuple(0 if a == axis else slice(None) for a in range(3))
+    idx_hi = tuple(n - 1 if a == axis else slice(None) for a in range(3))
+
+    def neighbor(delta):
+        # Dict form of a MESH device id: only the communication axis moves.
+        # (Scalar form on 1-axis meshes — interpret mode's discharge rule
+        # only handles that shape.)
+        idx = lax.rem(my + delta + size, size)
+        if len(mesh_axes) == 1:
+            return idx
+        return {axis_name: idx}
+
+    # Every device exchanges ring-wise in both directions, including the
+    # domain-edge wrap (the ICI torus has those links anyway); non-periodic
+    # edge ghosts are overwritten with the BC value afterwards. Keeping the
+    # transfer pattern fully symmetric avoids conditional DMAs, which both
+    # Mosaic's collective matching and interpret mode handle poorly.
+
+    # Neighbor barrier: nobody starts pushing into a peer's ghost buffers
+    # until that peer has entered this kernel (guards against cross-call
+    # buffer reuse races). Skipped in interpret mode, whose emulation is
+    # synchronous and lacks barrier-semaphore support.
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        for delta in (-1, +1):
+            pltpu.semaphore_signal(
+                barrier,
+                inc=1,
+                device_id=neighbor(delta),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        pltpu.semaphore_wait(barrier, 2)
+
+    rdma_hi = pltpu.make_async_remote_copy(  # my high face -> hi nb's lo ghost
+        src_ref=u_ref.at[idx_hi],
+        dst_ref=lo_ref,
+        send_sem=send_sem.at[0],
+        recv_sem=recv_sem.at[0],
+        device_id=neighbor(+1),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    rdma_lo = pltpu.make_async_remote_copy(  # my low face -> lo nb's hi ghost
+        src_ref=u_ref.at[idx_lo],
+        dst_ref=hi_ref,
+        send_sem=send_sem.at[1],
+        recv_sem=recv_sem.at[1],
+        device_id=neighbor(-1),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    rdma_hi.start()
+    rdma_lo.start()
+    rdma_hi.wait()  # my send_sem[0] + my recv_sem[0] (lo nb's push into lo_ref)
+    rdma_lo.wait()
+
+    if not periodic:
+
+        @pl.when(my == 0)
+        def _fill_lo():
+            lo_ref[...] = jnp.full(lo_ref.shape, bc_value, lo_ref.dtype)
+
+        @pl.when(my == size - 1)
+        def _fill_hi():
+            hi_ref[...] = jnp.full(hi_ref.shape, bc_value, hi_ref.dtype)
+
+
+def exchange_axis_dma(
+    u: jax.Array,
+    axis: int,
+    axis_name: str,
+    axis_size: int,
+    mesh_axes,
+    periodic: bool,
+    bc_value: float = 0.0,
+    interpret: bool = False,
+) -> jax.Array:
+    """DMA-backed analogue of parallel.halo.exchange_axis: grow ``u`` by one
+    ghost layer along ``axis``, filled from mesh neighbors over ICI. Must
+    run inside shard_map."""
+    if axis_size == 1:
+        # Degenerate ring: no remote party. Same semantics as the ppermute
+        # path's special cases.
+        lo_face = lax.slice_in_dim(u, 0, 1, axis=axis)
+        hi_face = lax.slice_in_dim(u, u.shape[axis] - 1, u.shape[axis], axis=axis)
+        if periodic:
+            ghost_lo, ghost_hi = hi_face, lo_face
+        else:
+            ghost_lo = jnp.full_like(lo_face, bc_value)
+            ghost_hi = jnp.full_like(hi_face, bc_value)
+        return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
+
+    plane_shape = tuple(s for a, s in enumerate(u.shape) if a != axis)
+    slab_shape = tuple(1 if a == axis else s for a, s in enumerate(u.shape))
+    kernel = functools.partial(
+        _axis_exchange_kernel,
+        axis=axis,
+        axis_name=axis_name,
+        mesh_axes=tuple(mesh_axes),
+        size=axis_size,
+        periodic=periodic,
+        bc_value=bc_value,
+        use_barrier=not interpret,
+    )
+    ghost_lo, ghost_hi = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(plane_shape, u.dtype),
+            jax.ShapeDtypeStruct(plane_shape, u.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=axis,
+        ),
+        interpret=interpret,
+    )(u)
+    return lax.concatenate(
+        [ghost_lo.reshape(slab_shape), u, ghost_hi.reshape(slab_shape)],
+        dimension=axis,
+    )
+
+
+def exchange_halo_dma(
+    u: jax.Array,
+    mesh_cfg: MeshConfig,
+    bc: BoundaryCondition,
+    bc_value: float = 0.0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Full 3D DMA ghost exchange: local (nx,ny,nz) -> (nx+2,ny+2,nz+2).
+    Axis-ordered like the ppermute path so corner ghosts propagate. Must run
+    inside shard_map over the mesh in ``mesh_cfg``."""
+    periodic = bc is BoundaryCondition.PERIODIC
+    for axis, (axis_name, axis_size) in enumerate(
+        zip(mesh_cfg.axis_names, mesh_cfg.shape)
+    ):
+        u = exchange_axis_dma(
+            u,
+            axis,
+            axis_name,
+            axis_size,
+            mesh_cfg.axis_names,
+            periodic,
+            bc_value,
+            interpret=interpret,
+        )
+    return u

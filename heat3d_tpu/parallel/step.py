@@ -42,19 +42,53 @@ def _solver_taps(cfg: SolverConfig) -> np.ndarray:
     )
 
 
+def _pin_padding(u_new: jax.Array, cfg: SolverConfig) -> jax.Array:
+    """For uneven decompositions, re-pin storage-padding cells (global index
+    >= grid extent) to bc_value after each update. Real cells adjacent to
+    the true boundary then read bc_value from their padded neighbors —
+    exactly the Dirichlet ghost — and padded cells contribute zero to the
+    residual (old == new == bc_value). Must run inside shard_map."""
+    if not cfg.is_padded:
+        return u_new
+    mask = None
+    for axis, (name, g, n) in enumerate(
+        zip(cfg.mesh.axis_names, cfg.grid.shape, cfg.local_shape)
+    ):
+        if g == cfg.padded_shape[axis]:
+            continue
+        global_idx = lax.axis_index(name) * n + jnp.arange(n)
+        shape = [1, 1, 1]
+        shape[axis] = n
+        m = (global_idx < g).reshape(shape)
+        mask = m if mask is None else jnp.logical_and(mask, m)
+    return jnp.where(mask, u_new, jnp.asarray(cfg.stencil.bc_value, u_new.dtype))
+
+
+def _exchange(u_local: jax.Array, cfg: SolverConfig) -> jax.Array:
+    """Ghost exchange via the configured transport (cfg.halo)."""
+    if cfg.halo == "dma":
+        from heat3d_tpu.ops.halo_pallas import exchange_halo_dma
+
+        return exchange_halo_dma(
+            u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value
+        )
+    return exchange_halo(u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value)
+
+
 def _local_step(
     u_local: jax.Array,
     taps: np.ndarray,
     cfg: SolverConfig,
     compute_padded: LocalCompute,
 ) -> jax.Array:
-    up = exchange_halo(u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value)
-    return compute_padded(
+    up = _exchange(u_local, cfg)
+    u_new = compute_padded(
         up,
         taps,
         compute_dtype=jnp.dtype(cfg.precision.compute),
         out_dtype=jnp.dtype(cfg.precision.storage),
     )
+    return _pin_padding(u_new, cfg)
 
 
 def _local_step_overlap(
@@ -78,8 +112,8 @@ def _local_step_overlap(
     compute_dtype = jnp.dtype(cfg.precision.compute)
     out_dtype = jnp.dtype(cfg.precision.storage)
 
-    # Ghost exchange: the ppermutes this step overlaps with.
-    up = exchange_halo(u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value)
+    # Ghost exchange: the transfers this step overlaps with.
+    up = _exchange(u_local, cfg)
 
     # Interior update from the local block alone (u_local acts as its own
     # ghost-padded input for the (nx-2, ny-2, nz-2) interior) — the bulk of
@@ -103,7 +137,7 @@ def _local_step_overlap(
             idx = [0, 0, 0]
             idx[axis] = pos
             out = lax.dynamic_update_slice(out, face, tuple(idx))
-    return out
+    return _pin_padding(out, cfg)
 
 
 def make_step_fn(
@@ -124,6 +158,13 @@ def make_step_fn(
             raise ValueError(
                 f"overlap=True needs local blocks >= 3 per axis to have an "
                 f"interior, got {cfg.local_shape}"
+            )
+        if cfg.halo == "dma":
+            raise ValueError(
+                "overlap=True requires halo='ppermute': the overlap comes "
+                "from XLA's async collective-permutes, which the "
+                "side-effecting DMA kernels do not participate in — the "
+                "combination would pay the split-step overhead for no overlap"
             )
         local_step = _local_step_overlap
 

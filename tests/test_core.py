@@ -25,9 +25,22 @@ def test_stable_dt_isotropic():
     assert g.stable_dt() == pytest.approx(1.0 / (2.0 * 2.0 * 3.0))
 
 
-def test_solver_config_rejects_indivisible():
-    with pytest.raises(ValueError, match="not divisible"):
-        SolverConfig(grid=GridConfig.cube(10), mesh=MeshConfig(shape=(4, 1, 1)))
+def test_solver_config_uneven_padding():
+    cfg = SolverConfig(grid=GridConfig.cube(10), mesh=MeshConfig(shape=(4, 1, 1)))
+    assert cfg.is_padded
+    assert cfg.padded_shape == (12, 10, 10)
+    assert cfg.local_shape == (3, 10, 10)
+    even = SolverConfig(grid=GridConfig.cube(8), mesh=MeshConfig(shape=(4, 1, 1)))
+    assert not even.is_padded and even.padded_shape == (8, 8, 8)
+
+
+def test_solver_config_rejects_uneven_periodic():
+    with pytest.raises(ValueError, match="periodic"):
+        SolverConfig(
+            grid=GridConfig.cube(10),
+            mesh=MeshConfig(shape=(4, 1, 1)),
+            stencil=StencilConfig(bc=BoundaryCondition.PERIODIC),
+        )
 
 
 def test_dims_create_balanced():

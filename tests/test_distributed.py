@@ -225,6 +225,33 @@ def test_bf16_strong_scale_config_lowers():
     assert "f32" in txt  # fp32 residual accumulation survives
 
 
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_dma_halo_step_lowers_for_multichip_tpu(kind):
+    """The Pallas RDMA halo path (halo='dma') composes with the full step
+    and lowers to Mosaic (tpu_custom_call) for a (2,2,2) mesh — the
+    compile-only tier for the CUDA-aware-analogue transport."""
+    cfg = SolverConfig(
+        grid=GridConfig.cube(16),
+        stencil=StencilConfig(kind=kind),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        backend="jnp",
+        halo="dma",
+    )
+    am = abstract_mesh(cfg.mesh)
+    step = make_step_fn(cfg, am, with_residual=True)
+    lowered = lower_for_mesh(
+        step, cfg.mesh, (cfg.grid.shape, jnp.float32, P("x", "y", "z"))
+    )
+    txt = lowered.as_text()
+    assert "tpu_custom_call" in txt  # the Mosaic DMA kernels
+    assert "all-reduce" in txt or "all_reduce" in txt  # residual psum
+
+
+def test_unknown_halo_transport_rejected():
+    with pytest.raises(ValueError, match="halo transport"):
+        SolverConfig(grid=GridConfig.cube(8), halo="nccl")
+
+
 def test_multistep_loop_is_device_side():
     cfg = SolverConfig(
         grid=GridConfig.cube(16),
