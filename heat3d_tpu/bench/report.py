@@ -1,0 +1,123 @@
+"""Render benchmark JSONL into the BASELINE.md measured tables.
+
+Reference parity (SURVEY.md §5 "Metrics / logging"): the reference prints
+its timing from rank 0; here benchmark runs emit one JSON line per result
+(bench.harness) and this module turns a results file into the markdown
+tables in BASELINE.md, between the ``<!-- measured:begin/end -->`` markers,
+so the scaling tables regenerate mechanically instead of being hand-edited.
+
+Usage::
+
+    python -m heat3d_tpu.bench --grid 512 ... >> bench_results.jsonl
+    python -m heat3d_tpu.bench.report bench_results.jsonl [BASELINE.md]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+BEGIN = "<!-- measured:begin -->"
+END = "<!-- measured:end -->"
+
+
+def load_results(path: str) -> List[Dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(r, dict) and r.get("bench") in ("throughput", "halo"):
+                out.append(r)
+    return out
+
+
+def _fmt_grid(grid) -> str:
+    if len(set(grid)) == 1:
+        return f"{grid[0]}³"
+    return "×".join(str(g) for g in grid)
+
+
+def _fmt_mesh(mesh) -> str:
+    return "×".join(str(m) for m in mesh)
+
+
+def render(results: List[Dict]) -> str:
+    lines = []
+    thr = [r for r in results if r["bench"] == "throughput"]
+    halo = [r for r in results if r["bench"] == "halo"]
+    if thr:
+        lines += [
+            "### Throughput (measured)",
+            "",
+            "| Grid | Stencil | Mesh | Dtype | Backend | Steps | Gcell/s | Gcell/s/chip | RTT-dominated |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for r in thr:
+            lines.append(
+                f"| {_fmt_grid(r['grid'])} | {r['stencil']} | "
+                f"{_fmt_mesh(r['mesh'])} | {r['dtype']} | {r['backend']} | "
+                f"{r['steps']} | {r['gcell_per_sec']:.2f} | "
+                f"{r['gcell_per_sec_per_chip']:.2f} | "
+                f"{'yes' if r.get('rtt_dominated') else 'no'} |"
+            )
+        lines.append("")
+    if halo:
+        lines += [
+            "### Halo exchange (measured)",
+            "",
+            "| Grid | Mesh | Dtype | p50 µs | p95 µs | min µs | bytes/device | RTT-dominated |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for r in halo:
+            lines.append(
+                f"| {_fmt_grid(r['grid'])} | {_fmt_mesh(r['mesh'])} | "
+                f"{r['dtype']} | {r['p50_us']:.1f} | {r['p95_us']:.1f} | "
+                f"{r['min_us']:.1f} | {r['halo_bytes_per_device']} | "
+                f"{'yes' if r.get('rtt_dominated') else 'no'} |"
+            )
+        lines.append("")
+    if not lines:
+        lines = ["(no benchmark results found)", ""]
+    return "\n".join(lines)
+
+
+def update_baseline_md(results: List[Dict], baseline_path: str) -> None:
+    with open(baseline_path) as f:
+        text = f.read()
+    block = f"{BEGIN}\n\n{render(results)}{END}"
+    if BEGIN in text and END in text:
+        pre = text.split(BEGIN)[0]
+        post = text.split(END)[1]
+        text = pre + block + post
+    else:
+        text = text.rstrip() + "\n\n## Measured results\n\n" + block + "\n"
+    with open(baseline_path, "w") as f:
+        f.write(text)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    results_path = argv[0]
+    baseline = argv[1] if len(argv) > 1 else "BASELINE.md"
+    results = load_results(results_path)
+    update_baseline_md(results, baseline)
+    print(
+        f"updated {baseline}: {len(results)} results "
+        f"({sum(r['bench'] == 'throughput' for r in results)} throughput, "
+        f"{sum(r['bench'] == 'halo' for r in results)} halo)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

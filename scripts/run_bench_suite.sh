@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Run the benchmark suite on this machine's chips and regenerate the
+# measured tables in BASELINE.md (SURVEY.md §2 C9, §5 "Metrics").
+#
+# Usage: scripts/run_bench_suite.sh [results.jsonl]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${1:-bench_results.jsonl}
+: > "$OUT"
+
+# Single-chip sweep: sizes that fit one chip; the multi-chip judged grids
+# need a pod slice (same flags, bigger --grid/--mesh). Override the sweep
+# with GRIDS/DTYPES/STEPS env vars (e.g. GRIDS=32 for a CPU smoke run).
+for dtype in ${DTYPES:-fp32 bf16}; do
+  for grid in ${GRIDS:-256 512}; do
+    python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
+      --dtype "$dtype" --mesh 1 1 1 >> "$OUT" 2>/dev/null
+  done
+done
+
+python -m heat3d_tpu.bench.report "$OUT" BASELINE.md

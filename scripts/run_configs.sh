@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Judged-config launcher — the mpirun-script analogue (SURVEY.md §2 C12).
+#
+# The reference is launched as `mpirun -np P ./heat3d NX NY NZ NITER`; here
+# one Python process runs per host and jax.distributed handles rendezvous.
+# On a multi-host pod slice, set for each host:
+#   COORD=<host0-addr:port> NPROC=<num hosts> PID=<this host's index>
+# Single host (or single chip): leave them unset.
+#
+# Usage: scripts/run_configs.sh <1|2|3|4|5> [extra heat3d flags...]
+set -euo pipefail
+
+CONFIG=${1:?usage: run_configs.sh <1-5> [flags]}
+shift || true
+
+DIST_FLAGS=()
+if [[ -n "${COORD:-}" ]]; then
+  DIST_FLAGS=(--coordinator "$COORD" --num-processes "${NPROC:?}" --process-id "${PID:?}")
+fi
+
+case "$CONFIG" in
+  1) # 128^3, 7-point, single rank, golden-checked (BASELINE.json config 1)
+     exec python -m heat3d_tpu --grid 128 --steps 100 --mesh 1 1 1 \
+       --golden-check "${DIST_FLAGS[@]}" "$@" ;;
+  2) # 1024^3, 7-point, 1D slab on 8 chips (config 2)
+     exec python -m heat3d_tpu --grid 1024 --steps 1000 --mesh 8 1 1 \
+       "${DIST_FLAGS[@]}" "$@" ;;
+  3) # 2048^3, 7-point, 3D block 2x2x2 on 8 chips (config 3)
+     exec python -m heat3d_tpu --grid 2048 --steps 1000 --mesh 2 2 2 \
+       "${DIST_FLAGS[@]}" "$@" ;;
+  4) # 4096^3, 27-point, 3D block on 64 chips (config 4)
+     exec python -m heat3d_tpu --grid 4096 --steps 500 --stencil 27pt \
+       --mesh 4 4 4 "${DIST_FLAGS[@]}" "$@" ;;
+  5) # 4096^3 strong-scale, bf16 stencil + fp32 residual on 128 chips (config 5)
+     exec python -m heat3d_tpu --grid 4096 --steps 500 --dtype bf16 \
+       --mesh 8 4 4 "${DIST_FLAGS[@]}" "$@" ;;
+  *) echo "unknown config $CONFIG (want 1-5)" >&2; exit 2 ;;
+esac

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Run any heat3d command on a simulated 8-device CPU mesh — the moral
+# equivalent of the reference's `mpirun -np 8` single-node oversubscription
+# test (SURVEY.md §4). Extra args pass through to `python -m heat3d_tpu`.
+#
+# Usage: scripts/run_cpu_mesh8.sh --grid 64 --steps 10 --mesh 2 2 2 --golden-check
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  python -m heat3d_tpu "$@"

@@ -32,6 +32,42 @@ def test_halo_result_shape():
     json.dumps(r)
 
 
+def test_report_renders_and_updates_markers(tmp_path):
+    from heat3d_tpu.bench import report
+
+    results = tmp_path / "r.jsonl"
+    results.write_text(
+        json.dumps(
+            {
+                "bench": "throughput", "grid": [512, 512, 512],
+                "stencil": "7pt", "mesh": [1, 1, 1], "dtype": "float32",
+                "backend": "auto", "steps": 50, "gcell_per_sec": 31.0,
+                "gcell_per_sec_per_chip": 31.0, "rtt_dominated": False,
+            }
+        )
+        + "\n"
+        + json.dumps(
+            {
+                "bench": "halo", "grid": [512, 512, 512], "mesh": [2, 2, 2],
+                "dtype": "float32", "p50_us": 120.0, "p95_us": 150.0,
+                "min_us": 100.0, "halo_bytes_per_device": 4096,
+                "rtt_dominated": False,
+            }
+        )
+        + "\nnot json\n"
+    )
+    md = tmp_path / "B.md"
+    md.write_text("# B\n\nintro\n")
+    report.main([str(results), str(md)])
+    text = md.read_text()
+    assert report.BEGIN in text and report.END in text
+    assert "| 512³ | 7pt | 1×1×1 |" in text
+    assert "| 512³ | 2×2×2 |" in text
+    # second run replaces, not duplicates, the measured block
+    report.main([str(results), str(md)])
+    assert md.read_text().count(report.BEGIN) == 1
+
+
 def test_root_bench_emits_one_json_line():
     out = subprocess.run(
         [sys.executable, "bench.py"],
