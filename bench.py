@@ -7,7 +7,8 @@ exist — BASELINE.json ``published`` is empty), pinned at 100 Gcell/s/chip,
 the middle of the 50-200 roofline band.
 
 Env overrides: HEAT3D_BENCH_GRID (int, cube edge), HEAT3D_BENCH_STEPS,
-HEAT3D_BENCH_DTYPE (fp32|bf16), HEAT3D_BENCH_BACKEND (auto|jnp|pallas).
+HEAT3D_BENCH_DTYPE (fp32|bf16), HEAT3D_BENCH_BACKEND (auto|jnp|pallas),
+HEAT3D_BENCH_TIME_BLOCKING (1|2: updates per halo exchange / HBM sweep).
 """
 
 from __future__ import annotations
@@ -38,6 +39,7 @@ def main() -> int:
     steps = int(os.environ.get("HEAT3D_BENCH_STEPS", 50 if on_tpu else 10))
     dtype = os.environ.get("HEAT3D_BENCH_DTYPE", "fp32")
     backend = os.environ.get("HEAT3D_BENCH_BACKEND", "auto")
+    time_blocking = int(os.environ.get("HEAT3D_BENCH_TIME_BLOCKING", "1"))
 
     cfg = SolverConfig(
         grid=GridConfig.cube(edge),
@@ -46,6 +48,7 @@ def main() -> int:
         precision=Precision.bf16() if dtype == "bf16" else Precision.fp32(),
         run=RunConfig(num_steps=steps),
         backend=backend,
+        time_blocking=time_blocking,
     )
     r = bench_throughput(cfg, steps=steps, warmup=1, repeats=3)
     gcells = r["gcell_per_sec_per_chip"]
@@ -62,6 +65,7 @@ def main() -> int:
                     "steps": steps,
                     "dtype": dtype,
                     "backend": backend,
+                    "time_blocking": time_blocking,
                     "platform": platform,
                     "seconds": round(elapsed, 4),
                 },

@@ -69,6 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--halo", choices=["ppermute", "dma"], default="ppermute",
                    help="ghost-exchange transport: XLA collective-permute or "
                    "Pallas remote-DMA kernels (TPU only)")
+    p.add_argument("--time-blocking", type=int, choices=[1, 2], default=1,
+                   help="stencil updates per ghost exchange in the "
+                   "fixed-step loop (2 = temporal blocking: width-2 halos, "
+                   "half the messages; convergence mode --tol checks the "
+                   "residual every step and always runs single updates)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--init", default="hot-cube", help="hot-cube | gaussian | random")
     p.add_argument("--seed", type=int, default=0)
@@ -127,6 +132,7 @@ def config_from_args(args) -> SolverConfig:
         backend=args.backend,
         overlap=args.overlap,
         halo=args.halo,
+        time_blocking=args.time_blocking,
     )
 
 
@@ -142,6 +148,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg.grid.shape, cfg.stencil.kind, cfg.mesh.shape,
         cfg.precision.storage, cfg.backend, len(jax.devices()),
     )
+    if cfg.run.tolerance is not None and cfg.time_blocking != 1:
+        log.warning(
+            "--time-blocking applies to the fixed-step loop only; "
+            "convergence mode (--tol) checks the residual every step and "
+            "runs single updates"
+        )
     solver = HeatSolver3D(cfg)
 
     start_step = 0

@@ -211,6 +211,181 @@ def apply_taps_pallas_stream(
     )(up)
 
 
+def _stream2_vmem_bytes(
+    shape: Tuple[int, int, int], in_itemsize: int, out_itemsize: int
+) -> int:
+    """VMEM footprint of the fused two-step kernel: input ring (3) + its
+    pipeline (2), intermediate ring (3), output pipeline (2)."""
+    ny, nz = shape[1], shape[2]
+    plane_a = _round_up(ny + 4, _SUBLANE) * _round_up(nz + 4, _LANE) * in_itemsize
+    plane_b = _round_up(ny + 2, _SUBLANE) * _round_up(nz + 2, _LANE) * in_itemsize
+    plane_o = _round_up(ny, _SUBLANE) * _round_up(nz, _LANE) * out_itemsize
+    return 5 * plane_a + 3 * plane_b + 2 * plane_o
+
+
+def stream2_supported(
+    shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
+) -> bool:
+    return _stream2_vmem_bytes(shape, in_itemsize, out_itemsize) <= 13 * 1024 * 1024
+
+
+def _plane_taps(plane_values, taps_flat, ny, nz, compute_dtype):
+    """Apply the 3x3x3 taps to a dict of three x-planes, producing the
+    (ny, nz) update of the middle plane's interior window."""
+    acc = None
+    for di, dj, dk, w in taps_flat:
+        sl = plane_values[di][1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+        term = compute_dtype(w) * sl
+        acc = term if acc is None else acc + term
+    return acc
+
+
+def _stream2_kernel(
+    in_ref,
+    out_ref,
+    ring_a,
+    ring_b,
+    *,
+    taps_flat,
+    nx,
+    ny,
+    nz,
+    compute_dtype,
+    storage_dtype,
+    out_dtype,
+    periodic,
+    bc_value,
+    axis_names,
+):
+    """Fused two-update streaming stencil (temporal blocking).
+
+    Grid step i: (a) load width-2-padded input plane i into a 3-slot ring;
+    (b) once 3 input planes are resident, compute intermediate plane
+    m = i-2 — one ghost ring wide, (ny+2, nz+2) — into a second ring,
+    pinning Dirichlet domain-ghost cells to bc_value exactly as the unfused
+    sequence would (edge-ness per axis comes from lax.axis_index, so the
+    same kernel serves single-device and interior/edge shards); (c) once 3
+    intermediate planes exist, emit output plane o = i-4. Both updates
+    happen per HBM sweep: bytes/update halve vs the single-step kernel.
+    """
+    i = pl.program_id(0)
+    bc = compute_dtype(bc_value)
+
+    def edges(axis_name):
+        idx = jax.lax.axis_index(axis_name)
+        size = jax.lax.axis_size(axis_name)
+        return idx == 0, idx == size - 1
+
+    for k in range(3):
+
+        @pl.when(jax.lax.rem(i, 3) == k)
+        def _load(k=k):
+            ring_a[k] = in_ref[0]
+
+    # (b) intermediate plane m = i-2 from input planes (i-2, i-1, i).
+    for k in range(3):  # k == i % 3
+
+        @pl.when(jnp.logical_and(i >= 2, jax.lax.rem(i, 3) == k))
+        def _mid(k=k):
+            planes = {
+                -1: ring_a[(k + 1) % 3].astype(compute_dtype),
+                0: ring_a[(k + 2) % 3].astype(compute_dtype),
+                1: ring_a[k].astype(compute_dtype),
+            }
+            mid = _plane_taps(planes, taps_flat, ny + 2, nz + 2, compute_dtype)
+            if not periodic:
+                m = i - 2
+                x_lo, x_hi = edges(axis_names[0])
+                y_lo, y_hi = edges(axis_names[1])
+                z_lo, z_hi = edges(axis_names[2])
+                ghost_plane = jnp.logical_or(
+                    jnp.logical_and(m == 0, x_lo),
+                    jnp.logical_and(m == nx + 1, x_hi),
+                )
+                row = jax.lax.broadcasted_iota(jnp.int32, (ny + 2, 1), 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, (1, nz + 2), 1)
+                ring = jnp.logical_or(
+                    jnp.logical_or(
+                        jnp.logical_and(row == 0, y_lo),
+                        jnp.logical_and(row == ny + 1, y_hi),
+                    ),
+                    jnp.logical_or(
+                        jnp.logical_and(col == 0, z_lo),
+                        jnp.logical_and(col == nz + 1, z_hi),
+                    ),
+                )
+                mid = jnp.where(jnp.logical_or(ghost_plane, ring), bc, mid)
+            # round-trip through storage dtype so fused == unfused bitwise
+            ring_b[(k + 1) % 3] = mid.astype(storage_dtype)  # slot (i-2)%3
+
+    # (c) output plane o = i-4 from intermediate planes (i-4, i-3, i-2).
+    for k in range(3):  # k == i % 3; (i-4)%3 == (k+2)%3, (i-3)%3 == k
+
+        @pl.when(jnp.logical_and(i >= 4, jax.lax.rem(i, 3) == k))
+        def _out(k=k):
+            planes = {
+                -1: ring_b[(k + 2) % 3].astype(compute_dtype),
+                0: ring_b[k].astype(compute_dtype),
+                1: ring_b[(k + 1) % 3].astype(compute_dtype),
+            }
+            out_ref[0] = _plane_taps(
+                planes, taps_flat, ny, nz, compute_dtype
+            ).astype(out_dtype)
+
+
+def apply_taps_pallas_stream2(
+    up2: jax.Array,
+    taps: np.ndarray,
+    mesh_axis_names=("x", "y", "z"),
+    periodic: bool = False,
+    bc_value: float = 0.0,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused two-update Pallas stencil: width-2 ghost-padded
+    (nx+4, ny+4, nz+4) block in, (nx, ny, nz) double-updated interior out.
+    Must run inside shard_map over mesh_axis_names (size-1 axes included) so
+    the kernel can detect domain edges for Dirichlet ghost pinning."""
+    nx, ny, nz = up2.shape[0] - 4, up2.shape[1] - 4, up2.shape[2] - 4
+    out_dtype = out_dtype or up2.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+    kernel = functools.partial(
+        _stream2_kernel,
+        taps_flat=flat,
+        nx=nx,
+        ny=ny,
+        nz=nz,
+        compute_dtype=compute_dtype,
+        storage_dtype=up2.dtype,
+        out_dtype=jnp.dtype(out_dtype),
+        periodic=periodic,
+        bc_value=bc_value,
+        axis_names=tuple(mesh_axis_names),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(nx + 4,),
+        in_specs=[pl.BlockSpec((1, ny + 4, nz + 4), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec(
+            (1, ny, nz), lambda i: (jnp.maximum(i - 4, 0), 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((3, ny + 4, nz + 4), up2.dtype),
+            pltpu.VMEM((3, ny + 2, nz + 2), up2.dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * len(flat) * nx * ny * nz,
+            bytes_accessed=(nx + 4) * (ny + 4) * (nz + 4) * up2.dtype.itemsize
+            + nx * ny * nz * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(up2)
+
+
 def _stencil_kernel(in_ref, out_ref, *, taps, bx, by, nz, compute_dtype, out_dtype):
     """One (bx, by, nz) output tile from a (bx+2, by+2, nz+2) input window.
 

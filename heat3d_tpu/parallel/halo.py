@@ -46,13 +46,20 @@ def exchange_axis(
     axis_size: int,
     periodic: bool,
     bc_value: float = 0.0,
+    width: int = 1,
 ) -> jax.Array:
-    """Pad local block ``u`` with 1 ghost layer along ``axis``, filled from
-    the neighbors along mesh axis ``axis_name`` (or the BC at the domain
-    boundary). Must run inside shard_map. Returns u grown by 2 on ``axis``.
-    """
-    lo_face = lax.slice_in_dim(u, 0, 1, axis=axis)
-    hi_face = lax.slice_in_dim(u, u.shape[axis] - 1, u.shape[axis], axis=axis)
+    """Pad local block ``u`` with ``width`` ghost layers along ``axis``,
+    filled from the neighbors along mesh axis ``axis_name`` (or the BC at
+    the domain boundary). Must run inside shard_map. Returns u grown by
+    2*width on ``axis``. width > 1 serves temporal blocking (several stencil
+    applications per exchange — fewer, larger messages)."""
+    n = u.shape[axis]
+    if n < width:
+        raise ValueError(
+            f"halo width {width} exceeds local extent {n} on axis {axis}"
+        )
+    lo_face = lax.slice_in_dim(u, 0, width, axis=axis)
+    hi_face = lax.slice_in_dim(u, n - width, n, axis=axis)
 
     if axis_size == 1 and periodic:
         # self-wrap: my own faces are my ghosts
@@ -83,14 +90,17 @@ def exchange_halo(
     mesh_cfg: MeshConfig,
     bc: BoundaryCondition,
     bc_value: float = 0.0,
+    width: int = 1,
 ) -> jax.Array:
-    """Full 3D ghost exchange: local (nx,ny,nz) -> (nx+2,ny+2,nz+2), ghosts
-    filled from mesh neighbors / the boundary condition. Axis-ordered so the
-    result equals a global pad-then-shard (corner ghosts included). Must run
-    inside shard_map over the mesh in ``mesh_cfg``."""
+    """Full 3D ghost exchange: local (nx,ny,nz) -> (nx+2w,ny+2w,nz+2w),
+    ghosts filled from mesh neighbors / the boundary condition. Axis-ordered
+    so the result equals a global pad-then-shard (corner ghosts included).
+    Must run inside shard_map over the mesh in ``mesh_cfg``."""
     periodic = bc is BoundaryCondition.PERIODIC
     for axis, (axis_name, axis_size) in enumerate(
         zip(mesh_cfg.axis_names, mesh_cfg.shape)
     ):
-        u = exchange_axis(u, axis, axis_name, axis_size, periodic, bc_value)
+        u = exchange_axis(
+            u, axis, axis_name, axis_size, periodic, bc_value, width
+        )
     return u

@@ -64,15 +64,69 @@ def _pin_padding(u_new: jax.Array, cfg: SolverConfig) -> jax.Array:
     return jnp.where(mask, u_new, jnp.asarray(cfg.stencil.bc_value, u_new.dtype))
 
 
-def _exchange(u_local: jax.Array, cfg: SolverConfig) -> jax.Array:
+def _exchange(
+    u_local: jax.Array, cfg: SolverConfig, width: int = 1
+) -> jax.Array:
     """Ghost exchange via the configured transport (cfg.halo)."""
     if cfg.halo == "dma":
         from heat3d_tpu.ops.halo_pallas import exchange_halo_dma
 
+        if width != 1:
+            raise NotImplementedError("halo='dma' supports width=1 only")
         return exchange_halo_dma(
             u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value
         )
-    return exchange_halo(u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value)
+    return exchange_halo(
+        u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value, width
+    )
+
+
+def _fill_mid_ghosts(mid: jax.Array, cfg: SolverConfig) -> jax.Array:
+    """Between the two applications of a temporally-blocked superstep, pin
+    the cells of the ring-carrying intermediate that are NOT true interior
+    cells — global domain ghosts (Dirichlet ring) and uneven-decomposition
+    padding — back to bc_value, exactly as the unfused sequence sees them.
+    ``mid`` carries one ghost ring: local index i maps to global index
+    device_start + i - 1. Periodic needs no fill (wrap ghosts of the
+    intermediate are genuinely-updated wrapped cells). Must run inside
+    shard_map."""
+    if cfg.stencil.bc is BoundaryCondition.PERIODIC:
+        return mid
+    mask = None
+    for axis, (name, g, n) in enumerate(
+        zip(cfg.mesh.axis_names, cfg.grid.shape, cfg.local_shape)
+    ):
+        global_idx = lax.axis_index(name) * n + jnp.arange(-1, n + 1)
+        m = jnp.logical_and(global_idx >= 0, global_idx < g)
+        shape = [1, 1, 1]
+        shape[axis] = n + 2
+        m = m.reshape(shape)
+        mask = m if mask is None else jnp.logical_and(mask, m)
+    return jnp.where(mask, mid, jnp.asarray(cfg.stencil.bc_value, mid.dtype))
+
+
+def _local_step2(
+    u_local: jax.Array,
+    taps: np.ndarray,
+    cfg: SolverConfig,
+    compute_padded: LocalCompute,
+) -> jax.Array:
+    """One temporally-blocked superstep: TWO stencil updates per ghost
+    exchange and (with a fused kernel) per HBM sweep — the overlapping-halo
+    trick (exchange width-2 ghosts, apply the stencil twice, the second
+    application consuming the ring the first one produced). Halves the
+    number of ICI messages per update and doubles arithmetic intensity."""
+    compute_dtype = jnp.dtype(cfg.precision.compute)
+    out_dtype = jnp.dtype(cfg.precision.storage)
+    up2 = _exchange(u_local, cfg, width=2)
+    mid = compute_padded(
+        up2, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+    )
+    mid = _fill_mid_ghosts(mid, cfg)
+    out = compute_padded(
+        mid, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+    )
+    return _pin_padding(out, cfg)
 
 
 def _local_step(
@@ -192,6 +246,73 @@ def make_step_fn(
     )
 
 
+def make_superstep_fn(
+    cfg: SolverConfig,
+    mesh: Mesh,
+    compute_padded: LocalCompute = apply_taps_padded,
+):
+    """Build the sharded temporally-blocked ``u -> u_after_2_steps``
+    superstep (see _local_step2). Requires cfg.time_blocking-compatible
+    settings: ppermute halo, no overlap split, local extents >= 2."""
+    if cfg.halo == "dma":
+        raise ValueError("time_blocking=2 requires halo='ppermute'")
+    if cfg.overlap:
+        raise ValueError(
+            "time_blocking=2 and overlap=True are mutually exclusive — the "
+            "superstep already restructures the exchange/compute schedule"
+        )
+    if min(cfg.local_shape) < 2:
+        raise ValueError(
+            f"time_blocking=2 needs local extents >= 2, got {cfg.local_shape}"
+        )
+    taps = _solver_taps(cfg)
+    spec = P(*cfg.mesh.axis_names)
+
+    # Prefer the fused two-update Pallas kernel (both stencil applications
+    # in one HBM sweep); fall back to two compute_padded applications (which
+    # still halves the exchanges).
+    fused = None
+    if cfg.backend in ("pallas", "auto") and not cfg.is_padded:
+        try:
+            from heat3d_tpu.ops.stencil_pallas import (
+                apply_taps_pallas_stream2,
+                stream2_supported,
+            )
+
+            itemsize = jnp.dtype(cfg.precision.storage).itemsize
+            if (
+                jax.devices()[0].platform == "tpu"
+                and stream2_supported(cfg.local_shape, itemsize, itemsize)
+            ):
+                fused = apply_taps_pallas_stream2
+        except ImportError:
+            pass
+
+    if fused is not None:
+        periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
+
+        def local(u_local):
+            up2 = _exchange(u_local, cfg, width=2)
+            return fused(
+                up2,
+                taps,
+                mesh_axis_names=cfg.mesh.axis_names,
+                periodic=periodic,
+                bc_value=cfg.stencil.bc_value,
+                compute_dtype=jnp.dtype(cfg.precision.compute),
+                out_dtype=jnp.dtype(cfg.precision.storage),
+            )
+
+    else:
+
+        def local(u_local):
+            return _local_step2(u_local, taps, cfg, compute_padded)
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+
+
 def make_multistep_fn(
     cfg: SolverConfig,
     mesh: Mesh,
@@ -200,8 +321,22 @@ def make_multistep_fn(
     """Build ``(u, num_steps) -> u_after`` with the fori_loop *inside* the
     compiled program. num_steps is a traced scalar so one executable serves
     any step count (the reference recompiles nothing either — its loop is
-    host-side; ours is device-side, SURVEY.md §3.2 TPU mapping)."""
+    host-side; ours is device-side, SURVEY.md §3.2 TPU mapping).
+
+    With cfg.time_blocking == 2, the loop advances in two-update supersteps
+    (half the exchanges) plus one trailing single step for odd counts."""
     step = make_step_fn(cfg, mesh, compute_padded, with_residual=False)
+
+    if cfg.time_blocking == 2:
+        superstep = make_superstep_fn(cfg, mesh, compute_padded)
+
+        def run2(u, num_steps):
+            u = lax.fori_loop(
+                0, num_steps // 2, lambda _, v: superstep(v), u
+            )
+            return lax.cond(num_steps % 2 == 1, step, lambda v: v, u)
+
+        return run2
 
     def run(u, num_steps):
         return lax.fori_loop(0, num_steps, lambda _, v: step(v), u)

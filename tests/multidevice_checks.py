@@ -138,6 +138,40 @@ def check_uneven_decomposition():
     print("uneven_decomposition OK")
 
 
+def check_time_blocking_distributed():
+    """Temporally-blocked supersteps == plain steps on real multi-device
+    meshes, including uneven decompositions (where the intermediate's
+    padding/ghost pinning is the subtle part)."""
+    import dataclasses
+
+    for grid, mesh_shape, kind, bc in [
+        ((16, 16, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET),
+        ((16, 16, 16), (2, 2, 2), "27pt", BoundaryCondition.PERIODIC),
+        ((16, 16, 16), (8, 1, 1), "27pt", BoundaryCondition.DIRICHLET),
+        ((10, 9, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET),  # uneven
+    ]:
+        cfg = SolverConfig(
+            grid=GridConfig(shape=grid),
+            stencil=StencilConfig(kind=kind, bc=bc, bc_value=0.5
+                                  if bc is BoundaryCondition.DIRICHLET else 0.0),
+            mesh=MeshConfig(shape=mesh_shape),
+            backend="jnp",
+        )
+        cfg2 = dataclasses.replace(cfg, time_blocking=2)
+        u_host = golden.random_init(grid, seed=17)
+        from heat3d_tpu.models.heat3d import HeatSolver3D
+
+        s1 = HeatSolver3D(cfg)
+        s2 = HeatSolver3D(cfg2)
+        u1 = s1.run(s1.init_state(u_host), 5)
+        u2 = s2.run(s2.init_state(u_host), 5)
+        np.testing.assert_allclose(
+            s1.gather(u1), s2.gather(u2), rtol=1e-6, atol=1e-6,
+            err_msg=f"grid={grid} mesh={mesh_shape} kind={kind} bc={bc}",
+        )
+    print("time_blocking_distributed OK")
+
+
 def check_bf16_distributed():
     grid = (16, 16, 16)
     cfg = SolverConfig(
@@ -313,6 +347,7 @@ def main():
     check_step_matches_single_device()
     check_overlap_step_distributed()
     check_uneven_decomposition()
+    check_time_blocking_distributed()
     check_bf16_distributed()
     check_halo_ghost_identity()
     check_multistep_vs_golden()

@@ -140,6 +140,45 @@ def test_overlap_multichip_lowers_with_collectives():
     assert "collective-permute" in txt or "collective_permute" in txt
 
 
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize(
+    "bc,bc_value",
+    [
+        (BoundaryCondition.DIRICHLET, 0.0),
+        (BoundaryCondition.DIRICHLET, 2.0),
+        (BoundaryCondition.PERIODIC, 0.0),
+    ],
+)
+@pytest.mark.parametrize("steps", [1, 2, 5])
+def test_time_blocking_equals_single_steps(kind, bc, bc_value, steps):
+    """The temporally-blocked loop (two updates per width-2 exchange) must
+    reproduce the plain per-step loop for odd and even step counts."""
+    import dataclasses
+
+    cfg = solo_cfg(kind=kind, bc=bc, bc_value=bc_value)
+    cfg2 = dataclasses.replace(cfg, time_blocking=2)
+    mesh = build_mesh(cfg.mesh)
+    u = jnp.asarray(golden.random_init((8, 8, 8), seed=33))
+    got = jax.jit(make_multistep_fn(cfg2, mesh))(u, jnp.int32(steps))
+    want = jax.jit(make_multistep_fn(cfg, mesh))(u, jnp.int32(steps))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_time_blocking_rejects_dma_and_overlap():
+    import dataclasses
+
+    from heat3d_tpu.parallel.step import make_superstep_fn
+
+    base = dataclasses.replace(solo_cfg(), time_blocking=2)
+    mesh = build_mesh(base.mesh)
+    with pytest.raises(ValueError, match="ppermute"):
+        make_superstep_fn(dataclasses.replace(base, halo="dma"), mesh)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_superstep_fn(dataclasses.replace(base, overlap=True), mesh)
+
+
 def test_residual_psum_replicated():
     cfg = solo_cfg()
     mesh = build_mesh(cfg.mesh)
