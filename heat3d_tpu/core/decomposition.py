@@ -56,10 +56,11 @@ def neighbor_rank(
 def local_extent(global_n: int, parts: int, index: int) -> Tuple[int, int]:
     """(start, size) of block ``index`` of ``global_n`` cells over ``parts``
     blocks. Handles uneven division the canonical way (first ``global_n %
-    parts`` blocks get one extra cell) — SURVEY.md §7.3 item 4. The
-    distributed execution path currently requires even division (sharding
-    constraint); this function is the general contract used by tests and
-    checkpoint indexing."""
+    parts`` blocks get one extra cell) — SURVEY.md §7.3 item 4. Note the
+    distributed execution path takes a different route for uneven grids
+    (equal blocks over a bc-padded storage shape, SolverConfig.padded_shape);
+    this function is the general contract used by tests and checkpoint
+    indexing."""
     if not (0 <= index < parts):
         raise ValueError(f"index {index} out of range for {parts} parts")
     base, rem = divmod(global_n, parts)

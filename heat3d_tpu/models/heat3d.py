@@ -106,12 +106,22 @@ class HeatSolver3D:
             make_step_fn(cfg, self.mesh, compute, with_residual=True),
             donate_argnums=0,
         )
-        self._multistep = jax.jit(
-            make_multistep_fn(cfg, self.mesh, compute), donate_argnums=0
-        )
+        # Built on first use: the fixed-step loop validates time_blocking
+        # constraints (halo transport, local extents) that convergence-mode
+        # runs never exercise.
+        self._multistep_cache = None
         self._converge = jax.jit(
             make_converge_fn(cfg, self.mesh, compute), donate_argnums=0
         )
+
+    @property
+    def _multistep(self):
+        if self._multistep_cache is None:
+            self._multistep_cache = jax.jit(
+                make_multistep_fn(self.cfg, self.mesh, self._compute),
+                donate_argnums=0,
+            )
+        return self._multistep_cache
 
     # ---- state -----------------------------------------------------------
 
@@ -206,8 +216,15 @@ class HeatSolver3D:
 
     def gather(self, u: jax.Array) -> np.ndarray:
         """Fetch the full field to host (small grids / tests only), with any
-        uneven-decomposition storage padding stripped."""
-        full = np.asarray(jax.device_get(u))
+        uneven-decomposition storage padding stripped. Multi-host safe: when
+        shards live on other processes this is a collective
+        (process_allgather), so every process must call it."""
+        if u.is_fully_addressable:
+            full = np.asarray(jax.device_get(u))
+        else:
+            from jax.experimental import multihost_utils
+
+            full = np.asarray(multihost_utils.process_allgather(u, tiled=True))
         if full.shape != self.cfg.grid.shape:
             full = full[tuple(slice(0, g) for g in self.cfg.grid.shape)]
         return full

@@ -64,7 +64,7 @@ def _pin_padding(u_new: jax.Array, cfg: SolverConfig) -> jax.Array:
     return jnp.where(mask, u_new, jnp.asarray(cfg.stencil.bc_value, u_new.dtype))
 
 
-def _exchange(
+def exchange(
     u_local: jax.Array, cfg: SolverConfig, width: int = 1
 ) -> jax.Array:
     """Ghost exchange via the configured transport (cfg.halo)."""
@@ -118,7 +118,7 @@ def _local_step2(
     number of ICI messages per update and doubles arithmetic intensity."""
     compute_dtype = jnp.dtype(cfg.precision.compute)
     out_dtype = jnp.dtype(cfg.precision.storage)
-    up2 = _exchange(u_local, cfg, width=2)
+    up2 = exchange(u_local, cfg, width=2)
     mid = compute_padded(
         up2, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
     )
@@ -135,7 +135,7 @@ def _local_step(
     cfg: SolverConfig,
     compute_padded: LocalCompute,
 ) -> jax.Array:
-    up = _exchange(u_local, cfg)
+    up = exchange(u_local, cfg)
     u_new = compute_padded(
         up,
         taps,
@@ -167,7 +167,7 @@ def _local_step_overlap(
     out_dtype = jnp.dtype(cfg.precision.storage)
 
     # Ghost exchange: the transfers this step overlaps with.
-    up = _exchange(u_local, cfg)
+    up = exchange(u_local, cfg)
 
     # Interior update from the local block alone (u_local acts as its own
     # ghost-padded input for the (nx-2, ny-2, nz-2) interior) — the bulk of
@@ -292,7 +292,7 @@ def make_superstep_fn(
         periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
 
         def local(u_local):
-            up2 = _exchange(u_local, cfg, width=2)
+            up2 = exchange(u_local, cfg, width=2)
             return fused(
                 up2,
                 taps,

@@ -79,7 +79,7 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
 
     from heat3d_tpu.core.config import BoundaryCondition
     from heat3d_tpu.ops.stencil_pallas import apply_taps_pallas_stream2
-    from heat3d_tpu.parallel.step import _exchange, _local_step2
+    from heat3d_tpu.parallel.step import exchange, _local_step2
     from heat3d_tpu.parallel.topology import build_mesh
 
     bce = BoundaryCondition(bc)
@@ -101,7 +101,7 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
     )(u)
 
     def fused(x):
-        up2 = _exchange(x, cfg, width=2)
+        up2 = exchange(x, cfg, width=2)
         return apply_taps_pallas_stream2(
             up2, taps, ("x", "y", "z"),
             periodic=bce is BoundaryCondition.PERIODIC,
