@@ -69,10 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--halo", choices=["ppermute", "dma"], default="ppermute",
                    help="ghost-exchange transport: XLA collective-permute or "
                    "Pallas remote-DMA kernels (TPU only)")
-    p.add_argument("--time-blocking", type=int, choices=[1, 2], default=1,
+    p.add_argument("--time-blocking", type=int, default=1,
                    help="stencil updates per ghost exchange in the "
-                   "fixed-step loop (2 = temporal blocking: width-2 halos, "
-                   "half the messages; convergence mode --tol checks the "
+                   "fixed-step loop (k>1 = temporal blocking: width-k "
+                   "halos, 1/k the messages; k=2 also fuses both updates "
+                   "into one HBM sweep; convergence mode --tol checks the "
                    "residual every step and always runs single updates)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--init", default="hot-cube", help="hot-cube | gaussian | random")

@@ -206,17 +206,18 @@ class SolverConfig:
     # 'dma' (Pallas make_async_remote_copy kernels — the CUDA-aware/GPUDirect
     # analogue, SURVEY.md §7.1 item 7; TPU only).
     halo: str = "ppermute"
-    # Updates per ghost exchange in the fixed-step loop: 1 (classic) or 2
-    # (temporal blocking — width-2 halos, two stencil applications per
-    # superstep; halves ICI messages and, with the fused kernel, HBM sweeps).
+    # Updates per ghost exchange in the fixed-step loop (temporal blocking):
+    # k > 1 exchanges width-k halos and applies the stencil k times per
+    # superstep, cutting ICI messages k-fold; k == 2 additionally fuses both
+    # applications into one HBM sweep via a Pallas kernel.
     time_blocking: int = 1
 
     def __post_init__(self):
         if self.halo not in ("ppermute", "dma"):
             raise ValueError(f"unknown halo transport {self.halo!r}")
-        if self.time_blocking not in (1, 2):
+        if self.time_blocking < 1:
             raise ValueError(
-                f"time_blocking must be 1 or 2, got {self.time_blocking}"
+                f"time_blocking must be >= 1, got {self.time_blocking}"
             )
         if self.is_padded and self.stencil.bc is BoundaryCondition.PERIODIC:
             raise ValueError(

@@ -81,14 +81,16 @@ def exchange(
     )
 
 
-def _fill_mid_ghosts(mid: jax.Array, cfg: SolverConfig) -> jax.Array:
-    """Between the two applications of a temporally-blocked superstep, pin
-    the cells of the ring-carrying intermediate that are NOT true interior
-    cells — global domain ghosts (Dirichlet ring) and uneven-decomposition
+def _fill_mid_ghosts(
+    mid: jax.Array, cfg: SolverConfig, rings: int = 1
+) -> jax.Array:
+    """Between the applications of a temporally-blocked superstep, pin the
+    cells of the ring-carrying intermediate that are NOT true interior
+    cells — global domain ghosts (Dirichlet rings) and uneven-decomposition
     padding — back to bc_value, exactly as the unfused sequence sees them.
-    ``mid`` carries one ghost ring: local index i maps to global index
-    device_start + i - 1. Periodic needs no fill (wrap ghosts of the
-    intermediate are genuinely-updated wrapped cells). Must run inside
+    ``mid`` carries ``rings`` ghost rings: local index i maps to global
+    index device_start + i - rings. Periodic needs no fill (wrap ghosts of
+    the intermediate are genuinely-updated wrapped cells). Must run inside
     shard_map."""
     if cfg.stencil.bc is BoundaryCondition.PERIODIC:
         return mid
@@ -96,37 +98,38 @@ def _fill_mid_ghosts(mid: jax.Array, cfg: SolverConfig) -> jax.Array:
     for axis, (name, g, n) in enumerate(
         zip(cfg.mesh.axis_names, cfg.grid.shape, cfg.local_shape)
     ):
-        global_idx = lax.axis_index(name) * n + jnp.arange(-1, n + 1)
+        global_idx = lax.axis_index(name) * n + jnp.arange(-rings, n + rings)
         m = jnp.logical_and(global_idx >= 0, global_idx < g)
         shape = [1, 1, 1]
-        shape[axis] = n + 2
+        shape[axis] = n + 2 * rings
         m = m.reshape(shape)
         mask = m if mask is None else jnp.logical_and(mask, m)
     return jnp.where(mask, mid, jnp.asarray(cfg.stencil.bc_value, mid.dtype))
 
 
-def _local_step2(
+def _local_stepk(
     u_local: jax.Array,
     taps: np.ndarray,
     cfg: SolverConfig,
     compute_padded: LocalCompute,
 ) -> jax.Array:
-    """One temporally-blocked superstep: TWO stencil updates per ghost
-    exchange and (with a fused kernel) per HBM sweep — the overlapping-halo
-    trick (exchange width-2 ghosts, apply the stencil twice, the second
-    application consuming the ring the first one produced). Halves the
-    number of ICI messages per update and doubles arithmetic intensity."""
+    """One temporally-blocked superstep: ``k = cfg.time_blocking`` stencil
+    updates per ghost exchange — the overlapping-halo trick (exchange
+    width-k ghosts, apply the stencil k times; application j consumes the
+    ring application j-1 produced). Cuts ICI messages per update k-fold at
+    the cost of recomputing shrinking ghost rings."""
+    k = cfg.time_blocking
     compute_dtype = jnp.dtype(cfg.precision.compute)
     out_dtype = jnp.dtype(cfg.precision.storage)
-    up2 = exchange(u_local, cfg, width=2)
-    mid = compute_padded(
-        up2, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
-    )
-    mid = _fill_mid_ghosts(mid, cfg)
-    out = compute_padded(
-        mid, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
-    )
-    return _pin_padding(out, cfg)
+    cur = exchange(u_local, cfg, width=k)
+    for j in range(k):
+        cur = compute_padded(
+            cur, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+        )
+        rings = k - 1 - j  # ghost rings still carried by cur
+        if rings > 0:
+            cur = _fill_mid_ghosts(cur, cfg, rings)
+    return _pin_padding(cur, cfg)
 
 
 def _local_step(
@@ -251,28 +254,32 @@ def make_superstep_fn(
     mesh: Mesh,
     compute_padded: LocalCompute = apply_taps_padded,
 ):
-    """Build the sharded temporally-blocked ``u -> u_after_2_steps``
-    superstep (see _local_step2). Requires cfg.time_blocking-compatible
-    settings: ppermute halo, no overlap split, local extents >= 2."""
+    """Build the sharded temporally-blocked superstep ``u -> u_after_k_steps``
+    for ``k = cfg.time_blocking`` (see _local_stepk). Requires ppermute
+    halo, no overlap split, and local extents >= k."""
     if cfg.halo == "dma":
-        raise ValueError("time_blocking=2 requires halo='ppermute'")
+        raise ValueError(
+            f"time_blocking={cfg.time_blocking} requires halo='ppermute'"
+        )
     if cfg.overlap:
         raise ValueError(
-            "time_blocking=2 and overlap=True are mutually exclusive — the "
-            "superstep already restructures the exchange/compute schedule"
+            f"time_blocking={cfg.time_blocking} and overlap=True are "
+            "mutually exclusive — the superstep already restructures the "
+            "exchange/compute schedule"
         )
-    if min(cfg.local_shape) < 2:
+    if min(cfg.local_shape) < cfg.time_blocking:
         raise ValueError(
-            f"time_blocking=2 needs local extents >= 2, got {cfg.local_shape}"
+            f"time_blocking={cfg.time_blocking} needs local extents >= "
+            f"{cfg.time_blocking}, got {cfg.local_shape}"
         )
     taps = _solver_taps(cfg)
     spec = P(*cfg.mesh.axis_names)
 
-    # Prefer the fused two-update Pallas kernel (both stencil applications
-    # in one HBM sweep); fall back to two compute_padded applications (which
-    # still halves the exchanges).
+    # For k=2, prefer the fused two-update Pallas kernel (both stencil
+    # applications in one HBM sweep); otherwise k compute_padded
+    # applications (which still cuts the exchanges k-fold).
     fused = None
-    if cfg.backend in ("pallas", "auto") and not cfg.is_padded:
+    if cfg.time_blocking == 2 and cfg.backend in ("pallas", "auto") and not cfg.is_padded:
         try:
             from heat3d_tpu.ops.stencil_pallas import (
                 apply_taps_pallas_stream2,
@@ -306,7 +313,7 @@ def make_superstep_fn(
     else:
 
         def local(u_local):
-            return _local_step2(u_local, taps, cfg, compute_padded)
+            return _local_stepk(u_local, taps, cfg, compute_padded)
 
     return jax.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
@@ -323,20 +330,24 @@ def make_multistep_fn(
     any step count (the reference recompiles nothing either — its loop is
     host-side; ours is device-side, SURVEY.md §3.2 TPU mapping).
 
-    With cfg.time_blocking == 2, the loop advances in two-update supersteps
-    (half the exchanges) plus one trailing single step for odd counts."""
+    With cfg.time_blocking == k > 1, the loop advances in k-update
+    supersteps (1/k the exchanges) plus trailing single steps for the
+    remainder."""
     step = make_step_fn(cfg, mesh, compute_padded, with_residual=False)
 
-    if cfg.time_blocking == 2:
+    if cfg.time_blocking > 1:
+        k = cfg.time_blocking
         superstep = make_superstep_fn(cfg, mesh, compute_padded)
 
-        def run2(u, num_steps):
+        def runk(u, num_steps):
             u = lax.fori_loop(
-                0, num_steps // 2, lambda _, v: superstep(v), u
+                0, num_steps // k, lambda _, v: superstep(v), u
             )
-            return lax.cond(num_steps % 2 == 1, step, lambda v: v, u)
+            return lax.fori_loop(
+                0, num_steps % k, lambda _, v: step(v), u
+            )
 
-        return run2
+        return runk
 
     def run(u, num_steps):
         return lax.fori_loop(0, num_steps, lambda _, v: step(v), u)

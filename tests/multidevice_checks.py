@@ -144,11 +144,15 @@ def check_time_blocking_distributed():
     padding/ghost pinning is the subtle part)."""
     import dataclasses
 
-    for grid, mesh_shape, kind, bc in [
-        ((16, 16, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET),
-        ((16, 16, 16), (2, 2, 2), "27pt", BoundaryCondition.PERIODIC),
-        ((16, 16, 16), (8, 1, 1), "27pt", BoundaryCondition.DIRICHLET),
-        ((10, 9, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET),  # uneven
+    for grid, mesh_shape, kind, bc, k in [
+        ((16, 16, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET, 2),
+        ((16, 16, 16), (2, 2, 2), "27pt", BoundaryCondition.PERIODIC, 2),
+        ((16, 16, 16), (8, 1, 1), "27pt", BoundaryCondition.DIRICHLET, 2),
+        ((10, 9, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET, 2),  # uneven
+        # k=3: real cross-device width-3 ppermutes + 2-then-1-ring mid fills
+        ((16, 16, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET, 3),
+        ((16, 16, 16), (2, 2, 2), "27pt", BoundaryCondition.PERIODIC, 3),
+        ((16, 16, 16), (2, 2, 2), "7pt", BoundaryCondition.DIRICHLET, 4),
     ]:
         cfg = SolverConfig(
             grid=GridConfig(shape=grid),
@@ -157,7 +161,7 @@ def check_time_blocking_distributed():
             mesh=MeshConfig(shape=mesh_shape),
             backend="jnp",
         )
-        cfg2 = dataclasses.replace(cfg, time_blocking=2)
+        cfg2 = dataclasses.replace(cfg, time_blocking=k)
         u_host = golden.random_init(grid, seed=17)
         from heat3d_tpu.models.heat3d import HeatSolver3D
 

@@ -150,16 +150,17 @@ def test_overlap_multichip_lowers_with_collectives():
     ],
 )
 @pytest.mark.parametrize("steps", [1, 2, 5])
-def test_time_blocking_equals_single_steps(kind, bc, bc_value, steps):
-    """The temporally-blocked loop (two updates per width-2 exchange) must
-    reproduce the plain per-step loop for odd and even step counts."""
+@pytest.mark.parametrize("k", [2, 3, 5])
+def test_time_blocking_equals_single_steps(kind, bc, bc_value, steps, k):
+    """The temporally-blocked loop (k updates per width-k exchange) must
+    reproduce the plain per-step loop for any remainder."""
     import dataclasses
 
     cfg = solo_cfg(kind=kind, bc=bc, bc_value=bc_value)
-    cfg2 = dataclasses.replace(cfg, time_blocking=2)
+    cfgk = dataclasses.replace(cfg, time_blocking=k)
     mesh = build_mesh(cfg.mesh)
     u = jnp.asarray(golden.random_init((8, 8, 8), seed=33))
-    got = jax.jit(make_multistep_fn(cfg2, mesh))(u, jnp.int32(steps))
+    got = jax.jit(make_multistep_fn(cfgk, mesh))(u, jnp.int32(steps))
     want = jax.jit(make_multistep_fn(cfg, mesh))(u, jnp.int32(steps))
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6

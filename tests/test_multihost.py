@@ -86,7 +86,18 @@ def _run_pair(args, timeout=300):
                 except subprocess.TimeoutExpired:
                     for q in procs:
                         q.kill()
-                    raise
+                    dumps = []
+                    for qid, (of, ef) in enumerate(files):
+                        of.seek(0)
+                        ef.seek(0)
+                        dumps.append(
+                            f"--- proc {qid} stdout ---\n{of.read()}\n"
+                            f"--- proc {qid} stderr ---\n{ef.read()}"
+                        )
+                    raise AssertionError(
+                        "multihost pair timed out; captured output:\n"
+                        + "\n".join(dumps)
+                    ) from None
                 out_f.seek(0)
                 err_f.seek(0)
                 outs.append((p.returncode, out_f.read(), err_f.read()))

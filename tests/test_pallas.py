@@ -79,7 +79,7 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
 
     from heat3d_tpu.core.config import BoundaryCondition
     from heat3d_tpu.ops.stencil_pallas import apply_taps_pallas_stream2
-    from heat3d_tpu.parallel.step import exchange, _local_step2
+    from heat3d_tpu.parallel.step import exchange, _local_stepk
     from heat3d_tpu.parallel.topology import build_mesh
 
     bce = BoundaryCondition(bc)
@@ -96,7 +96,7 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
     spec = P("x", "y", "z")
 
     want = jax.shard_map(
-        lambda x: _local_step2(x, taps, cfg, apply_taps_padded),
+        lambda x: _local_stepk(x, taps, cfg, apply_taps_padded),
         mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
     )(u)
 
