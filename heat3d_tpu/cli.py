@@ -138,6 +138,17 @@ def config_from_args(args) -> SolverConfig:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _main(argv)
+    except (ValueError, NotImplementedError) as e:
+        # Config/capability errors (indivisible periodic meshes, halo='dma'
+        # off-TPU, time_blocking constraints, ...) exit cleanly instead of
+        # dumping a traceback — the reference's argv validation, done right.
+        print(f"heat3d: error: {e}", file=sys.stderr)
+        return 2
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     distributed.initialize(args.coordinator, args.num_processes, args.process_id)
     cfg = config_from_args(args)

@@ -15,4 +15,10 @@ C2/C3/C6, §5 "Distributed communication backend"):
 
 from heat3d_tpu.parallel.topology import abstract_mesh, build_mesh, partition_spec
 from heat3d_tpu.parallel.halo import exchange_halo
-from heat3d_tpu.parallel.step import make_step_fn, make_multistep_fn
+from heat3d_tpu.parallel.step import (
+    exchange,
+    make_converge_fn,
+    make_multistep_fn,
+    make_step_fn,
+    make_superstep_fn,
+)

@@ -143,6 +143,24 @@ def test_cli_checkpoint_resume(tmp_path, capsys):
     assert summary["steps"] >= 2
 
 
+def test_cli_profile_dir_emits_trace(tmp_path, capsys):
+    """--profile-dir wraps the run in jax.profiler.trace and writes
+    TensorBoard/Perfetto artifacts (SURVEY.md §5 'Tracing / profiling')."""
+    from heat3d_tpu.cli import main
+
+    prof = str(tmp_path / "prof")
+    assert main(["--grid", "16", "--steps", "3", "--backend", "jnp",
+                 "--profile-dir", prof]) == 0
+    capsys.readouterr()
+    artifacts = [
+        os.path.join(root, f)
+        for root, _, fs in os.walk(prof)
+        for f in fs
+        if f.endswith((".xplane.pb", ".trace.json.gz"))
+    ]
+    assert artifacts, f"no profiler artifacts under {prof}"
+
+
 def test_init_state_mesh_invariant():
     # The initializer must not depend on the decomposition (SURVEY.md §2 C8):
     # block-wise init == full init slice for the random initializer.
@@ -150,3 +168,14 @@ def test_init_state_mesh_invariant():
     u = solver.gather(solver.init_state("random"))
     want = golden.make_init("random", cfg.grid.shape, seed=0)
     np.testing.assert_array_equal(u, want)
+
+
+def test_cli_clean_config_errors(capsys):
+    """Config/capability errors exit 2 with a one-line message, no traceback
+    (the reference's argv validation, done right)."""
+    from heat3d_tpu.cli import main
+
+    rc = main(["--grid", "10", "--mesh", "4", "--bc", "periodic"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "heat3d: error:" in err and "Traceback" not in err
