@@ -74,6 +74,7 @@ def bench_throughput(
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "backend": cfg.backend,
+        "time_blocking": cfg.time_blocking,
         "steps": steps,
         "seconds_best": best,
         "seconds_all": times,

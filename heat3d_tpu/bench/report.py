@@ -48,6 +48,58 @@ def _fmt_mesh(mesh) -> str:
     return "×".join(str(m) for m in mesh)
 
 
+def scaling_rows(results: List[Dict]) -> List[Dict]:
+    """Compute weak/strong-scaling efficiency for multi-chip throughput rows
+    against the matching 1-chip baseline in the same result set.
+
+    Efficiency = per-chip rate / baseline per-chip rate (the BASELINE.json
+    north-star metric: >= 0.90 weak-scaling on the pod). Baselines match on
+    (stencil, dtype, backend, time_blocking); strong scaling pairs rows with
+    the SAME global grid, weak scaling pairs a multi-chip row with the
+    1-chip run of its per-chip LOCAL grid. Rows without a baseline are
+    skipped (the sweep script always emits the 1-chip runs first)."""
+    thr = [r for r in results if r["bench"] == "throughput"]
+
+    def key(r):
+        return (r["stencil"], r["dtype"], r["backend"], r.get("time_blocking", 1))
+
+    def nchips(r):
+        n = 1
+        for m in r["mesh"]:
+            n *= m
+        return n
+
+    base = {}
+    for r in thr:
+        if nchips(r) == 1:
+            base[(key(r), tuple(r["grid"]))] = r["gcell_per_sec_per_chip"]
+    rows = []
+    for r in thr:
+        n = nchips(r)
+        if n == 1:
+            continue
+        local = tuple(g // m for g, m in zip(r["grid"], r["mesh"]))
+        for mode, ref_grid in (("strong", tuple(r["grid"])), ("weak", local)):
+            b = base.get((key(r), ref_grid))
+            if b is None or b <= 0:
+                continue
+            rows.append(
+                {
+                    "mode": mode,
+                    "grid": r["grid"],
+                    "mesh": r["mesh"],
+                    "chips": n,
+                    "stencil": r["stencil"],
+                    "dtype": r["dtype"],
+                    "time_blocking": r.get("time_blocking", 1),
+                    "gcell_per_sec_per_chip": r["gcell_per_sec_per_chip"],
+                    "baseline_per_chip": b,
+                    "efficiency": r["gcell_per_sec_per_chip"] / b,
+                }
+            )
+    return rows
+
+
 def render(results: List[Dict]) -> str:
     lines = []
     thr = [r for r in results if r["bench"] == "throughput"]
@@ -66,6 +118,24 @@ def render(results: List[Dict]) -> str:
                 f"{r['steps']} | {r['gcell_per_sec']:.2f} | "
                 f"{r['gcell_per_sec_per_chip']:.2f} | "
                 f"{'yes' if r.get('rtt_dominated') else 'no'} |"
+            )
+        lines.append("")
+    scal = scaling_rows(results)
+    if scal:
+        lines += [
+            "### Scaling efficiency (measured, vs 1-chip baseline)",
+            "",
+            "| Mode | Grid | Mesh | Chips | Stencil | Dtype | tb | Gcell/s/chip | 1-chip | Efficiency |",
+            "|---|---|---|---|---|---|---|---|---|---|",
+        ]
+        for r in scal:
+            lines.append(
+                f"| {r['mode']} | {_fmt_grid(r['grid'])} | "
+                f"{_fmt_mesh(r['mesh'])} | {r['chips']} | {r['stencil']} | "
+                f"{r['dtype']} | {r['time_blocking']} | "
+                f"{r['gcell_per_sec_per_chip']:.2f} | "
+                f"{r['baseline_per_chip']:.2f} | "
+                f"{100 * r['efficiency']:.1f}% |"
             )
         lines.append("")
     if halo:

@@ -95,6 +95,23 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
     if jnp.dtype(cfg.precision.storage).itemsize not in (2, 4):
         return False, f"unsupported storage dtype {cfg.precision.storage}"
     itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    import os
+
+    if (
+        cfg.mesh.shape == (1, 1, 1)
+        and not cfg.is_padded
+        and not cfg.overlap
+        and cfg.halo == "ppermute"
+        and not os.environ.get("HEAT3D_NO_DIRECT")
+    ):
+        # same gate as parallel.step._direct_kernel_fn: only report the
+        # direct kernel as support when the dispatch will actually take it,
+        # else large single-shard configs would trace into the (infeasible)
+        # windowed kernel instead of falling back
+        from heat3d_tpu.ops.stencil_pallas_direct import direct_supported
+
+        if direct_supported(cfg.local_shape, 1, itemsize, itemsize):
+            return True, ""
     if stream_supported(cfg.local_shape, itemsize, itemsize):
         return True, ""  # streaming kernel: no Element windows needed
     if _Element is None:

@@ -1,0 +1,452 @@
+"""BC-fused direct streaming Pallas kernels — no padded-array materialization.
+
+The v1 hot path (``parallel.halo.exchange_halo`` + ``apply_taps_pallas_stream``)
+pays for a full ghost-padded copy of the field every step: XLA's
+``concatenate`` materializes the (nx+2, ny+2, nz+2) buffer (read + write of
+the whole volume) before the stencil kernel reads it again — roughly
+doubling HBM traffic, the roofline resource (SURVEY.md §6). The padded
+buffer's (ny+2, nz+2) planes are also sublane/lane-misaligned (514 rows/
+lanes pad to 520x640 VMEM tiles).
+
+These kernels instead read the UNPADDED field — whose (by, nz) plane-chunks
+are perfectly (8, 128)-tiled — and synthesize the boundary ghosts
+in-register: Dirichlet ghosts are constant fills, periodic ghosts are
+wrapped rows/planes fetched via modular BlockSpec index maps. HBM traffic
+drops to the streaming minimum (one read + one write per cell per update;
+the fused two-step variant halves that again), which is the whole game for
+a 7/27-point stencil at ~8 B/cell.
+
+Scope: a shard whose mesh is (1, 1, 1) — i.e. every boundary is a DOMAIN
+boundary (the judged single-chip benchmark config, and any axis-size-1
+shard_map axis). Multi-device shards keep the exchange+kernel path, whose
+ICI ghosts these kernels cannot synthesize locally.
+
+Layout: the local (nx, ny, nz) volume is walked as a 2D Pallas grid
+(J, nx + 2k) — y-chunk-column outer (J = ny/by picked to fit VMEM), x-plane
+inner — so arbitrarily large fields stream through a 3-slot VMEM plane ring
+exactly once per update. Reference parity (SURVEY.md §2 C1): this is the
+CUDA Jacobi kernel's job done the TPU way — the grid pipeline is the
+``__global__`` launch, the plane ring is the shared-memory tile, and the
+ghost synthesis replaces the separate boundary kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from heat3d_tpu.core.stencils import nonzero_taps
+
+_LANE = 128
+_SUBLANE = 8
+
+# Leave Mosaic headroom in the ~16 MB VMEM for spills and the semaphore pool.
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _plane_bytes(rows: int, lanes: int, itemsize: int) -> int:
+    return _round_up(rows, _SUBLANE) * _round_up(lanes, _LANE) * itemsize
+
+
+def _vmem_bytes(
+    by: int, nz: int, halo: int, in_itemsize: int, out_itemsize: int
+) -> int:
+    """VMEM footprint of the direct kernel at chunk height ``by`` and ghost
+    width ``halo`` (1 = single step, 2 = fused two-step): the assembled-plane
+    ring(s), the double-buffered input chunk + ghost-row pipeline, and the
+    double-buffered output pipeline."""
+    ring = 3 * _plane_bytes(by + 2 * halo, nz + 2 * halo, in_itemsize)
+    if halo == 2:  # fused two-step: second ring for the intermediate planes
+        ring += 3 * _plane_bytes(by + 2, nz + 2, in_itemsize)
+    pipe_in = 2 * (
+        _plane_bytes(by, nz, in_itemsize)
+        + 2 * halo * _plane_bytes(1, nz, in_itemsize)
+    )
+    pipe_out = 2 * _plane_bytes(by, nz, out_itemsize)
+    return ring + pipe_in + pipe_out
+
+
+def choose_chunk(
+    local_shape: Tuple[int, int, int],
+    halo: int = 1,
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+) -> Optional[int]:
+    """Largest y-chunk height ``by`` (a divisor of ny, multiple of 8 when
+    ny >= 8) whose working set fits the VMEM budget, or None."""
+    ny, nz = local_shape[1], local_shape[2]
+    for by in range(ny, 0, -1):
+        if ny % by:
+            continue
+        if ny >= 8 and by % 8:
+            continue
+        if _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize) <= _VMEM_BUDGET:
+            return by
+    return None
+
+
+def direct_supported(
+    local_shape: Tuple[int, int, int],
+    halo: int = 1,
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+) -> bool:
+    nx, ny, nz = local_shape
+    if halo == 2 and (nx < 2 or ny < 2 or nz < 2):
+        return False  # wrapped/clamped width-2 ghosts would alias interior
+    if halo == 2 and ny % 2:
+        return False  # 2-row ghost blocks need even wrapped offsets
+    return (
+        choose_chunk(local_shape, halo, in_itemsize, out_itemsize) is not None
+    )
+
+
+def _assemble_plane(chunk, top, bot, bc, periodic, sub_top, sub_bot):
+    """Build the ghost-framed plane (by+2h, nz+2h) from an aligned (by, nz)
+    chunk plus (h, nz) ghost-row blocks; h = halo width. ``sub_top`` /
+    ``sub_bot`` force the row blocks to the Dirichlet boundary value (domain-
+    edge chunk columns, where the clamped index map loaded dummy rows)."""
+    h = top.shape[0]
+    nz = chunk.shape[1]
+    if not periodic:
+        top = jnp.where(sub_top, jnp.full_like(top, bc), top)
+        bot = jnp.where(sub_bot, jnp.full_like(bot, bc), bot)
+    rows = jnp.concatenate([top, chunk, bot], axis=0)  # (by+2h, nz)
+    if periodic:
+        left = rows[:, nz - h :]
+        right = rows[:, :h]
+    else:
+        left = jnp.full((rows.shape[0], h), bc, rows.dtype)
+        right = left
+    return jnp.concatenate([left, rows, right], axis=1)  # (by+2h, nz+2h)
+
+
+# Tap accumulation shared with the exchange-path kernels: op order must stay
+# identical across kernels so fused == unfused results match to the ulp.
+from heat3d_tpu.ops.stencil_pallas import _plane_taps  # noqa: E402
+
+
+def _direct_kernel(
+    u_ref,
+    top_ref,
+    bot_ref,
+    out_ref,
+    ring,
+    *,
+    taps_flat,
+    nx,
+    by,
+    nz,
+    n_chunks,
+    periodic,
+    bc_value,
+    compute_dtype,
+    out_dtype,
+):
+    """Grid step (j, i): assemble ghost-framed plane p = i-1 of chunk column
+    j into a 3-slot ring; once 3 planes are resident emit output plane i-2.
+    Conceptual plane p runs -1 .. nx (the two x ghost planes); the index maps
+    wrap (periodic) or clamp (Dirichlet, substituted with bc here)."""
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    bc = u_ref.dtype.type(bc_value)
+
+    chunk = u_ref[0]  # (by, nz) aligned
+    top = top_ref[0]  # (1, nz)
+    bot = bot_ref[0]
+    plane = _assemble_plane(
+        chunk,
+        top,
+        bot,
+        bc,
+        periodic,
+        sub_top=j == 0,
+        sub_bot=j == n_chunks - 1,
+    )
+    if not periodic:
+        # Conceptual planes -1 and nx are domain ghost planes: the clamped
+        # load fetched plane 0 / nx-1; overwrite with the boundary value.
+        ghost_x = jnp.logical_or(i == 0, i == nx + 1)
+        plane = jnp.where(ghost_x, jnp.full_like(plane, bc), plane)
+
+    for k in range(3):
+
+        @pl.when(jax.lax.rem(i, 3) == k)
+        def _store(k=k):
+            ring[k] = plane
+
+    for k in range(3):
+
+        @pl.when(jnp.logical_and(i >= 2, jax.lax.rem(i, 3) == k))
+        def _emit(k=k):
+            # planes (i-2, i-1, i) live in slots ((k+1)%3, (k+2)%3, k)
+            planes = {
+                -1: ring[(k + 1) % 3].astype(compute_dtype),
+                0: ring[(k + 2) % 3].astype(compute_dtype),
+                1: ring[k].astype(compute_dtype),
+            }
+            out_ref[0] = _plane_taps(
+                planes, taps_flat, by, nz, compute_dtype
+            ).astype(out_dtype)
+
+
+def apply_taps_direct(
+    u: jax.Array,
+    taps: np.ndarray,
+    periodic: bool = False,
+    bc_value: float = 0.0,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """One stencil update of the full (1,1,1)-mesh shard: unpadded
+    (nx, ny, nz) in, (nx, ny, nz) out, boundary conditions synthesized
+    in-kernel. Equivalent to ``exchange_halo`` + ``apply_taps_padded`` at
+    half the HBM traffic."""
+    nx, ny, nz = u.shape
+    out_dtype = out_dtype or u.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    by = choose_chunk(
+        u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+    )
+    if by is None:
+        raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
+    n_chunks = ny // by
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+
+    if periodic:
+        x_of = lambda i: jax.lax.rem(i - 1 + nx, nx)
+        top_of = lambda j: jax.lax.rem(by * j - 1 + ny, ny)
+        bot_of = lambda j: jax.lax.rem(by * j + by, ny)
+    else:
+        x_of = lambda i: jnp.clip(i - 1, 0, nx - 1)
+        top_of = lambda j: jnp.maximum(by * j - 1, 0)
+        bot_of = lambda j: jnp.minimum(by * j + by, ny - 1)
+
+    kernel = functools.partial(
+        _direct_kernel,
+        taps_flat=flat,
+        nx=nx,
+        by=by,
+        nz=nz,
+        n_chunks=n_chunks,
+        periodic=periodic,
+        bc_value=bc_value,
+        compute_dtype=compute_dtype,
+        out_dtype=jnp.dtype(out_dtype),
+    )
+    flops_per_cell = 2 * len(flat)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_chunks, nx + 2),
+        in_specs=[
+            pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0)),
+            # single ghost rows above/below the chunk (block = 1 row)
+            pl.BlockSpec((1, 1, nz), lambda j, i: (x_of(i), top_of(j), 0)),
+            pl.BlockSpec((1, 1, nz), lambda j, i: (x_of(i), bot_of(j), 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, by, nz), lambda j, i: (jnp.maximum(i - 2, 0), j, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+        scratch_shapes=[pltpu.VMEM((3, by + 2, nz + 2), u.dtype)],
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_cell * nx * ny * nz,
+            bytes_accessed=nx * ny * nz
+            * (u.dtype.itemsize + jnp.dtype(out_dtype).itemsize),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(u, u, u)
+
+
+def _direct2_kernel(
+    u_ref,
+    top_ref,
+    bot_ref,
+    out_ref,
+    ring_a,
+    ring_b,
+    *,
+    taps_flat,
+    nx,
+    by,
+    nz,
+    n_chunks,
+    periodic,
+    bc_value,
+    compute_dtype,
+    storage_dtype,
+    out_dtype,
+):
+    """Fused two-update direct kernel (temporal blocking k=2 in one HBM
+    sweep). Grid step (j, i): (a) assemble width-2 ghost-framed input plane
+    q = i (conceptual global plane i-2) into ring_a; (b) at i>=2 compute
+    intermediate plane m = i-2 (global i-4, one ghost ring wide) into
+    ring_b, pinning Dirichlet domain ghosts exactly as the unfused sequence
+    sees them; (c) at i>=4 emit output plane o = i-4 (global). Same plane
+    indexing as ops.stencil_pallas._stream2_kernel; only the input source
+    (assembled vs pre-padded) differs. Chunk columns recompute their two
+    boundary intermediate rows — ~2/by duplicated VPU work, no extra HBM."""
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    bc_s = u_ref.dtype.type(bc_value)
+    bc_c = compute_dtype(bc_value)
+
+    chunk = u_ref[0]  # (by, nz)
+    top = top_ref[0]  # (2, nz)
+    bot = bot_ref[0]
+    plane = _assemble_plane(
+        chunk,
+        top,
+        bot,
+        bc_s,
+        periodic,
+        sub_top=j == 0,
+        sub_bot=j == n_chunks - 1,
+    )  # (by+4, nz+4)
+    if not periodic:
+        ghost_x = jnp.logical_or(i <= 1, i >= nx + 2)
+        plane = jnp.where(ghost_x, jnp.full_like(plane, bc_s), plane)
+
+    for k in range(3):
+
+        @pl.when(jax.lax.rem(i, 3) == k)
+        def _load(k=k):
+            ring_a[k] = plane
+
+    # (b) intermediate plane m = i-2 from input planes (i-2, i-1, i).
+    for k in range(3):  # k == i % 3
+
+        @pl.when(jnp.logical_and(i >= 2, jax.lax.rem(i, 3) == k))
+        def _mid(k=k):
+            planes = {
+                -1: ring_a[(k + 1) % 3].astype(compute_dtype),
+                0: ring_a[(k + 2) % 3].astype(compute_dtype),
+                1: ring_a[k].astype(compute_dtype),
+            }
+            mid = _plane_taps(
+                planes, taps_flat, by + 2, nz + 2, compute_dtype
+            )
+            if not periodic:
+                m = i - 2  # 0 .. nx+1 in 1-ring coords; 0 / nx+1 = ghosts
+                ghost_plane = jnp.logical_or(m == 0, m == nx + 1)
+                row = jax.lax.broadcasted_iota(jnp.int32, (by + 2, 1), 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, (1, nz + 2), 1)
+                # domain ghost rows exist only on the edge chunk columns;
+                # interior chunk borders hold genuinely-updated cells
+                ring_mask = jnp.logical_or(
+                    jnp.logical_or(
+                        jnp.logical_and(row == 0, j == 0),
+                        jnp.logical_and(row == by + 1, j == n_chunks - 1),
+                    ),
+                    jnp.logical_or(col == 0, col == nz + 1),
+                )
+                mid = jnp.where(
+                    jnp.logical_or(ghost_plane, ring_mask), bc_c, mid
+                )
+            # round-trip through storage dtype so fused == unfused bitwise
+            ring_b[(k + 1) % 3] = mid.astype(storage_dtype)  # slot (i-2)%3
+
+    # (c) output plane o = i-4 from intermediate planes (i-4, i-3, i-2).
+    for k in range(3):  # k == i % 3; (i-4)%3 == (k+2)%3, (i-3)%3 == k
+
+        @pl.when(jnp.logical_and(i >= 4, jax.lax.rem(i, 3) == k))
+        def _out(k=k):
+            planes = {
+                -1: ring_b[(k + 2) % 3].astype(compute_dtype),
+                0: ring_b[k].astype(compute_dtype),
+                1: ring_b[(k + 1) % 3].astype(compute_dtype),
+            }
+            out_ref[0] = _plane_taps(
+                planes, taps_flat, by, nz, compute_dtype
+            ).astype(out_dtype)
+
+
+def apply_taps_direct2(
+    u: jax.Array,
+    taps: np.ndarray,
+    periodic: bool = False,
+    bc_value: float = 0.0,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Two fused stencil updates of the full (1,1,1)-mesh shard in one HBM
+    sweep: unpadded (nx, ny, nz) in, (nx, ny, nz) after TWO updates out.
+    The single-chip analogue of the width-2-exchange + stream2 superstep,
+    minus the padded-copy materialization."""
+    nx, ny, nz = u.shape
+    if ny % 2:
+        raise ValueError(
+            f"apply_taps_direct2 needs even ny (2-row ghost blocks), got {ny}"
+        )
+    out_dtype = out_dtype or u.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    by = choose_chunk(
+        u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+    )
+    if by is None:
+        raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
+    n_chunks = ny // by
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+
+    if periodic:
+        x_of = lambda i: jax.lax.rem(i - 2 + 2 * nx, nx)
+        top_of = lambda j: jax.lax.rem(by * j - 2 + ny, ny) // 2
+        bot_of = lambda j: jax.lax.rem(by * j + by, ny) // 2
+    else:
+        x_of = lambda i: jnp.clip(i - 2, 0, nx - 1)
+        top_of = lambda j: jnp.maximum(by * j - 2, 0) // 2
+        bot_of = lambda j: jnp.minimum(by * j + by, ny - 2) // 2
+
+    kernel = functools.partial(
+        _direct2_kernel,
+        taps_flat=flat,
+        nx=nx,
+        by=by,
+        nz=nz,
+        n_chunks=n_chunks,
+        periodic=periodic,
+        bc_value=bc_value,
+        compute_dtype=compute_dtype,
+        storage_dtype=u.dtype,
+        out_dtype=jnp.dtype(out_dtype),
+    )
+    flops_per_cell = 2 * 2 * len(flat)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_chunks, nx + 4),
+        in_specs=[
+            pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0)),
+            # width-2 ghost-row blocks; 2-row blocks need even offsets,
+            # guaranteed by by % 8 == 0 (or the index maps' even clamps)
+            pl.BlockSpec((1, 2, nz), lambda j, i: (x_of(i), top_of(j), 0)),
+            pl.BlockSpec((1, 2, nz), lambda j, i: (x_of(i), bot_of(j), 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, by, nz), lambda j, i: (jnp.maximum(i - 4, 0), j, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((3, by + 4, nz + 4), u.dtype),
+            pltpu.VMEM((3, by + 2, nz + 2), u.dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_cell * nx * ny * nz,
+            bytes_accessed=nx * ny * nz
+            * (u.dtype.itemsize + jnp.dtype(out_dtype).itemsize),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(u, u, u)

@@ -148,6 +148,46 @@ def _local_step(
     return _pin_padding(u_new, cfg)
 
 
+def _direct_kernel_fn(cfg: SolverConfig, halo: int):
+    """Return the BC-fused direct Pallas kernel for this config, or None.
+
+    On a (1, 1, 1) mesh every shard boundary is a domain boundary, so the
+    kernel can synthesize the ghosts in-register and skip the ghost-padded
+    copy that ``exchange`` materializes (its concatenates are full-volume
+    HBM writes) — halving (tb=1) or quartering (tb=2) traffic on the
+    bandwidth-bound roofline. ``halo`` = updates fused per HBM sweep (1|2).
+    """
+    import os
+
+    if os.environ.get("HEAT3D_NO_DIRECT"):
+        return None
+    if cfg.mesh.shape != (1, 1, 1) or cfg.overlap or cfg.halo != "ppermute":
+        return None
+    if cfg.backend not in ("pallas", "auto"):
+        return None
+    if cfg.is_padded:
+        return None
+    # HEAT3D_DIRECT_INTERPRET exercises this dispatch path off-TPU (tests)
+    interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
+    if not interpret and jax.devices()[0].platform != "tpu":
+        return None
+    try:
+        from heat3d_tpu.ops.stencil_pallas_direct import (
+            apply_taps_direct,
+            apply_taps_direct2,
+            direct_supported,
+        )
+    except ImportError:
+        return None
+    itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    if not direct_supported(cfg.local_shape, halo, itemsize, itemsize):
+        return None
+    import functools
+
+    kernel = apply_taps_direct if halo == 1 else apply_taps_direct2
+    return functools.partial(kernel, interpret=True) if interpret else kernel
+
+
 def _local_step_overlap(
     u_local: jax.Array,
     taps: np.ndarray,
@@ -210,6 +250,20 @@ def make_step_fn(
     spec = P(*cfg.mesh.axis_names)
     axes = cfg.mesh.axis_names
     local_step = _local_step
+    direct = _direct_kernel_fn(cfg, halo=1)
+    if direct is not None:
+        periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
+
+        def local_step(u_local, taps, cfg, compute_padded):
+            return direct(
+                u_local,
+                taps,
+                periodic=periodic,
+                bc_value=cfg.stencil.bc_value,
+                compute_dtype=jnp.dtype(cfg.precision.compute),
+                out_dtype=jnp.dtype(cfg.precision.storage),
+            )
+
     if cfg.overlap:
         if min(cfg.local_shape) < 3:
             raise ValueError(
@@ -274,6 +328,28 @@ def make_superstep_fn(
         )
     taps = _solver_taps(cfg)
     spec = P(*cfg.mesh.axis_names)
+
+    # (1,1,1)-mesh k=2: the BC-fused direct kernel does both updates in one
+    # sweep of the UNPADDED field — no width-2 ghost copy at all.
+    if cfg.time_blocking == 2:
+        direct2 = _direct_kernel_fn(cfg, halo=2)
+        if direct2 is not None:
+            periodic2 = cfg.stencil.bc is BoundaryCondition.PERIODIC
+
+            def local2(u_local):
+                return direct2(
+                    u_local,
+                    taps,
+                    periodic=periodic2,
+                    bc_value=cfg.stencil.bc_value,
+                    compute_dtype=jnp.dtype(cfg.precision.compute),
+                    out_dtype=jnp.dtype(cfg.precision.storage),
+                )
+
+            return jax.shard_map(
+                local2, mesh=mesh, in_specs=spec, out_specs=spec,
+                check_vma=False,
+            )
 
     # For k=2, prefer the fused two-update Pallas kernel (both stencil
     # applications in one HBM sweep); otherwise k compute_padded

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Weak/strong-scaling sweep matrix -> JSONL + BASELINE.md efficiency tables.
+#
+# Emits, per (stencil, dtype): 1-chip baselines (one per distinct local
+# grid), then multi-chip runs over the mesh ladder. On the pod this is the
+# judged ≥90%-weak-scaling run (BASELINE.json north star); on the dev box
+# the same matrix executes on the virtual 8-device CPU mesh, proving the
+# plumbing end-to-end (numbers are CPU-only, not the record).
+#
+# Usage: [LOCAL=64] [STEPS=20] [MESHES="1 1 1;2 1 1;2 2 1;2 2 2"] \
+#        [ON_CPU_MESH=1] scripts/run_scaling_sweep.sh [out.jsonl]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-scaling_results.jsonl}"
+LOCAL="${LOCAL:-64}"             # per-chip edge for weak scaling
+STEPS="${STEPS:-20}"
+MESHES="${MESHES:-1 1 1;2 1 1;2 2 1;2 2 2}"
+STENCILS="${STENCILS:-7pt}"
+DTYPES="${DTYPES:-fp32}"
+
+max_chips=1
+IFS=';' read -ra MESH_LIST <<< "$MESHES"
+for m in "${MESH_LIST[@]}"; do
+  read -r mx my mz <<< "$m"
+  n=$((mx * my * mz))
+  (( n > max_chips )) && max_chips=$n
+done
+
+RUN=(python -m heat3d_tpu.bench)
+REPORT_MD="BASELINE.md"
+if [[ "${ON_CPU_MESH:-}" == "1" ]]; then
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=$max_chips"
+  unset PALLAS_AXON_POOL_IPS
+  # CPU numbers must never clobber the committed TPU record
+  REPORT_MD="${OUT%.jsonl}.md"
+  : > "$REPORT_MD"
+fi
+
+: > "$OUT"
+
+for stencil in $STENCILS; do
+  for dtype in $DTYPES; do
+    # 1-chip baselines: the weak-scaling local grid and every strong-scaling
+    # global grid (G = LOCAL * mesh extent per axis).
+    seen=""
+    for m in "${MESH_LIST[@]}"; do
+      read -r mx my mz <<< "$m"
+      g="$((LOCAL * mx)) $((LOCAL * my)) $((LOCAL * mz))"
+      case ";$seen;" in *";$g;"*) continue ;; esac
+      seen="$seen;$g"
+      "${RUN[@]}" --grid $g --mesh 1 1 1 --stencil "$stencil" \
+        --dtype "$dtype" --steps "$STEPS" --bench throughput >> "$OUT"
+    done
+    # multi-chip runs: weak scaling (local constant) — the same rows serve
+    # strong scaling wherever the global grid matches a baseline above.
+    for m in "${MESH_LIST[@]}"; do
+      read -r mx my mz <<< "$m"
+      n=$((mx * my * mz))
+      (( n == 1 )) && continue
+      "${RUN[@]}" --grid $((LOCAL * mx)) $((LOCAL * my)) $((LOCAL * mz)) \
+        --mesh "$mx" "$my" "$mz" --stencil "$stencil" --dtype "$dtype" \
+        --steps "$STEPS" --bench throughput >> "$OUT"
+    done
+  done
+done
+
+python -m heat3d_tpu.bench.report "$OUT" "$REPORT_MD"
+echo "sweep done -> $OUT, tables -> $REPORT_MD (meshes up to $max_chips chips)"

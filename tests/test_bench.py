@@ -3,6 +3,10 @@ and emits well-formed results."""
 
 import json
 import subprocess
+
+import numpy as np
+
+import pytest
 import sys
 
 from heat3d_tpu.bench.harness import bench_halo, bench_throughput
@@ -15,6 +19,7 @@ def tiny_cfg():
     )
 
 
+@pytest.mark.tpu_smoke
 def test_throughput_result_shape():
     r = bench_throughput(tiny_cfg(), steps=3, warmup=1, repeats=2)
     assert r["gcell_per_sec"] > 0
@@ -87,3 +92,32 @@ def test_root_bench_emits_one_json_line():
     line = out.stdout.strip().splitlines()[-1]
     d = json.loads(line)
     assert set(d) >= {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_scaling_rows_weak_and_strong():
+    from heat3d_tpu.bench.report import render, scaling_rows
+
+    def thr(grid, mesh, rate_per_chip):
+        return {
+            "bench": "throughput", "grid": grid, "mesh": mesh,
+            "stencil": "7pt", "dtype": "float32", "backend": "auto",
+            "time_blocking": 1, "steps": 10,
+            "gcell_per_sec": rate_per_chip * int(np.prod(mesh)),
+            "gcell_per_sec_per_chip": rate_per_chip,
+        }
+
+    results = [
+        thr([64, 64, 64], [1, 1, 1], 10.0),    # weak baseline (local 64^3)
+        thr([128, 64, 64], [1, 1, 1], 8.0),    # strong baseline (global)
+        thr([128, 64, 64], [2, 1, 1], 9.5),    # 2-chip run, local 64^3
+    ]
+    rows = scaling_rows(results)
+    by_mode = {r["mode"]: r for r in rows}
+    assert by_mode["weak"]["efficiency"] == pytest.approx(9.5 / 10.0)
+    assert by_mode["strong"]["efficiency"] == pytest.approx(9.5 / 8.0)
+    assert by_mode["weak"]["chips"] == 2
+    # efficiency table renders
+    assert "Scaling efficiency" in render(results)
+    # baselines with a different time_blocking don't match
+    results[0]["time_blocking"] = 2
+    assert all(r["mode"] != "weak" for r in scaling_rows(results))

@@ -8,12 +8,14 @@ class's ``mpirun -np 8`` single-node oversubscription test (SURVEY.md §4).
 """
 
 import os
+import pytest
 import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
+@pytest.mark.slow
 def test_multidevice_checks_on_cpu_mesh():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # disable axon plugin injection

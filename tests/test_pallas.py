@@ -117,6 +117,7 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
 
 
 @pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
+@pytest.mark.tpu_smoke
 def test_stream2_compiled_on_tpu():
     """Fused two-update kernel compiles and matches two jnp steps on
     hardware (the temporally-blocked bench path)."""
@@ -159,6 +160,7 @@ def test_pallas_supported_gating():
 
 
 @pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
+@pytest.mark.tpu_smoke
 @pytest.mark.parametrize("kind", ["7pt", "27pt"])
 def test_compiled_matches_jnp_on_tpu(kind):
     up = _padded((16, 32, 128), seed=3)

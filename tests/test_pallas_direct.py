@@ -1,0 +1,207 @@
+"""BC-fused direct streaming kernels: numerics (interpret mode), dispatch
+wiring, and compiled-on-TPU parity.
+
+The direct kernels read the UNPADDED field and synthesize domain ghosts
+in-register (ops/stencil_pallas_direct.py), replacing exchange+kernel on
+(1,1,1) meshes; equivalence to the jnp reference is to fp32 rounding-order
+tolerance (FMA contraction differs between fused XLA loops and per-plane
+kernel ops — ~1 ulp)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    Precision,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.ops.stencil_jnp import step_single_device
+from heat3d_tpu.ops.stencil_pallas_direct import (
+    apply_taps_direct,
+    apply_taps_direct2,
+    choose_chunk,
+    direct_supported,
+)
+
+on_tpu = jax.devices()[0].platform == "tpu"
+
+
+def _taps(kind, shape):
+    g = GridConfig(shape=shape)
+    return stencil_taps(STENCILS[kind], g.alpha, g.effective_dt(), g.spacing)
+
+
+CASES = [
+    (BoundaryCondition.DIRICHLET, 0.0),
+    (BoundaryCondition.DIRICHLET, 1.5),
+    (BoundaryCondition.PERIODIC, 0.0),
+]
+
+
+@pytest.mark.parametrize("shape", [(16, 16, 16), (8, 16, 32), (5, 16, 128), (3, 8, 8)])
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_direct_interpret_matches_jnp(shape, kind):
+    u = jnp.asarray(golden.random_init(shape, seed=1))
+    taps = _taps(kind, shape)
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        want = step_single_device(u, taps, bc, bcv)
+        got = apply_taps_direct(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+            err_msg=f"{shape} {kind} {bc} {bcv}",
+        )
+
+
+@pytest.mark.parametrize("shape", [(16, 16, 16), (8, 16, 32), (4, 4, 4)])
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_direct2_interpret_matches_two_steps(shape, kind):
+    u = jnp.asarray(golden.random_init(shape, seed=3))
+    taps = _taps(kind, shape)
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        want = step_single_device(
+            step_single_device(u, taps, bc, bcv), taps, bc, bcv
+        )
+        got = apply_taps_direct2(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+            err_msg=f"{shape} {kind} {bc} {bcv}",
+        )
+
+
+def test_direct_bf16_storage_fp32_compute():
+    shape = (16, 16, 16)
+    u = jnp.asarray(golden.random_init(shape, seed=2), jnp.bfloat16)
+    taps = _taps("7pt", shape)
+    want = step_single_device(
+        u, taps, BoundaryCondition.DIRICHLET, 0.5, Precision.bf16()
+    )
+    got = apply_taps_direct(
+        u, taps, periodic=False, bc_value=0.5, out_dtype=jnp.bfloat16,
+        interpret=True,
+    )
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(want, dtype=np.float32),
+        rtol=2e-2, atol=1e-2,  # one bf16 ulp of rounding-order headroom
+    )
+
+
+def test_chunking_feasibility():
+    # judged grids fit VMEM via y-chunking, fp32 and bf16, both halo widths
+    for edge in (256, 512, 1024):
+        for itemsize in (4, 2):
+            for halo in (1, 2):
+                by = choose_chunk((edge,) * 3, halo, itemsize, itemsize)
+                assert by is not None and edge % by == 0, (edge, itemsize, halo)
+    # no 8-multiple divisor of ny -> unsupported (falls back to exchange path)
+    assert not direct_supported((16, 12, 16), 1)
+    # width-2 ghosts would alias on sub-2 extents
+    assert not direct_supported((1, 8, 8), 2)
+    # odd ny: 2-row ghost blocks can't address odd wrapped offsets
+    assert not direct_supported((6, 5, 8), 2)
+    with pytest.raises(ValueError, match="even ny"):
+        apply_taps_direct2(
+            jnp.zeros((6, 5, 8)), _taps("7pt", (6, 5, 8)), periodic=True,
+            interpret=True,
+        )
+
+
+def test_dispatch_used_on_111_mesh(monkeypatch):
+    from heat3d_tpu.parallel.step import _direct_kernel_fn
+
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(16),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="auto",
+    )
+    assert _direct_kernel_fn(cfg, 1) is not None
+    assert _direct_kernel_fn(cfg, 2) is not None
+    # env kill-switch honored
+    monkeypatch.setenv("HEAT3D_NO_DIRECT", "1")
+    assert _direct_kernel_fn(cfg, 1) is None
+    monkeypatch.delenv("HEAT3D_NO_DIRECT")
+    # never off a (1,1,1) mesh or under overlap/jnp backend
+    assert _direct_kernel_fn(
+        dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 1, 1))), 1
+    ) is None
+    assert _direct_kernel_fn(dataclasses.replace(cfg, overlap=True), 1) is None
+    assert _direct_kernel_fn(dataclasses.replace(cfg, backend="jnp"), 1) is None
+
+
+def test_solver_end_to_end_direct_interpret(monkeypatch):
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    for tb, steps in ((1, 3), (2, 5)):  # 5 = 2 supersteps + 1 trailing step
+        cfg = SolverConfig(
+            grid=GridConfig.cube(16),
+            stencil=StencilConfig(kind="7pt", bc=BoundaryCondition.DIRICHLET),
+            mesh=MeshConfig(shape=(1, 1, 1)),
+            backend="auto",
+            time_blocking=tb,
+        )
+        s = HeatSolver3D(cfg)
+        u = s.run(s.init_state("gaussian"), steps)
+        want = golden.run(
+            golden.gaussian_init((16, 16, 16)).astype(np.float64),
+            cfg.grid, cfg.stencil, steps,
+        )
+        np.testing.assert_allclose(
+            s.gather(u), want, rtol=1e-5, atol=1e-6, err_msg=f"tb={tb}"
+        )
+
+
+@pytest.mark.tpu_smoke
+@pytest.mark.skipif(not on_tpu, reason="needs TPU")
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_direct_compiled_on_tpu(kind):
+    shape = (64, 64, 128)
+    u = jnp.asarray(golden.random_init(shape, seed=5))
+    taps = _taps(kind, shape)
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        want = step_single_device(u, taps, bc, bcv)
+        got = jax.jit(
+            lambda v: apply_taps_direct(v, taps, periodic=periodic, bc_value=bcv)
+        )(u)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+            err_msg=f"{kind} {bc}",
+        )
+
+
+@pytest.mark.tpu_smoke
+@pytest.mark.skipif(not on_tpu, reason="needs TPU")
+def test_direct2_compiled_on_tpu():
+    shape = (64, 64, 128)
+    u = jnp.asarray(golden.random_init(shape, seed=6))
+    taps = _taps("7pt", shape)
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        want = step_single_device(
+            step_single_device(u, taps, bc, bcv), taps, bc, bcv
+        )
+        got = jax.jit(
+            lambda v: apply_taps_direct2(v, taps, periodic=periodic, bc_value=bcv)
+        )(u)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+            err_msg=f"{bc}",
+        )
