@@ -1,6 +1,13 @@
 """Driver benchmark: prints ONE JSON line with the headline judged metric.
 
-Metric (BASELINE.json): Gcell-updates/sec/chip, 7-point Jacobi stencil.
+Metric (BASELINE.json): Gcell-updates/sec/chip, 7-point Jacobi stencil, on
+the judged 1024^3 grid floor (BASELINE.json ``metric`` names 1024^3-4096^3;
+falls back to 512^3 if the chip's HBM can't hold the working set). Runs the
+framework's best single-chip settings: temporal blocking k=2 via the
+BC-fused direct Pallas kernel — two updates per HBM sweep of the unpadded
+field — proven equal to plain stepping by tests/test_pallas_direct.py and
+tests/test_distributed.py.
+
 ``vs_baseline`` normalizes against the A100 + CUDA-aware-MPI per-chip
 estimate from BASELINE.md's sanity band (no published reference numbers
 exist — BASELINE.json ``published`` is empty), pinned at 100 Gcell/s/chip,
@@ -22,7 +29,7 @@ import jax
 A100_BASELINE_GCELLS_PER_CHIP = 100.0
 
 
-def main() -> int:
+def _run(edge, steps, dtype, backend, time_blocking):
     from heat3d_tpu.bench.harness import bench_throughput
     from heat3d_tpu.core.config import (
         GridConfig,
@@ -33,14 +40,6 @@ def main() -> int:
         StencilConfig,
     )
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
-    edge = int(os.environ.get("HEAT3D_BENCH_GRID", 512 if on_tpu else 128))
-    steps = int(os.environ.get("HEAT3D_BENCH_STEPS", 50 if on_tpu else 10))
-    dtype = os.environ.get("HEAT3D_BENCH_DTYPE", "fp32")
-    backend = os.environ.get("HEAT3D_BENCH_BACKEND", "auto")
-    time_blocking = int(os.environ.get("HEAT3D_BENCH_TIME_BLOCKING", "1"))
-
     cfg = SolverConfig(
         grid=GridConfig.cube(edge),
         stencil=StencilConfig(kind="7pt"),
@@ -50,9 +49,33 @@ def main() -> int:
         backend=backend,
         time_blocking=time_blocking,
     )
-    r = bench_throughput(cfg, steps=steps, warmup=1, repeats=3)
+    return bench_throughput(cfg, steps=steps, warmup=1, repeats=3)
+
+
+def main() -> int:
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    edge = int(os.environ.get("HEAT3D_BENCH_GRID", 1024 if on_tpu else 128))
+    steps = int(os.environ.get("HEAT3D_BENCH_STEPS", 50 if on_tpu else 10))
+    dtype = os.environ.get("HEAT3D_BENCH_DTYPE", "fp32")
+    backend = os.environ.get("HEAT3D_BENCH_BACKEND", "auto")
+    time_blocking = int(
+        os.environ.get("HEAT3D_BENCH_TIME_BLOCKING", "2" if on_tpu else "1")
+    )
+
+    fell_back = False
+    try:
+        r = _run(edge, steps, dtype, backend, time_blocking)
+    except Exception as e:  # noqa: BLE001 - judge artifact must degrade, not die
+        msg = str(e)
+        oom = "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
+        if not (oom and edge > 512):
+            raise
+        # judged floor doesn't fit this chip's HBM: record the 512^3 number
+        edge, fell_back = 512, True
+        r = _run(edge, steps, dtype, backend, time_blocking)
+
     gcells = r["gcell_per_sec_per_chip"]
-    elapsed = r["seconds_best"]
     print(
         json.dumps(
             {
@@ -67,7 +90,8 @@ def main() -> int:
                     "backend": backend,
                     "time_blocking": time_blocking,
                     "platform": platform,
-                    "seconds": round(elapsed, 4),
+                    "seconds": round(r["seconds_best"], 4),
+                    "oom_fallback": fell_back,
                 },
             }
         )

@@ -5,17 +5,22 @@ backend"): the reference's defining feature is CUDA-aware MPI — device
 pointers handed straight to MPI_Isend/Irecv so halo faces move NIC<->GPU
 with no host staging. The TPU-native moral equivalent is kernel-initiated
 inter-chip DMA: ``pltpu.make_async_remote_copy`` pushes my boundary face
-over ICI directly into the neighbor chip's ghost buffer, synchronized by
-DMA semaphores (SURVEY.md §7.1 item 7; the v1 path compiles
+slab over ICI directly into the neighbor chip's ghost buffer, synchronized
+by DMA semaphores (SURVEY.md §7.1 item 7; the v1 path compiles
 ``lax.ppermute`` to the same ICI transfers but through XLA's collective
 machinery).
 
 Exchange structure mirrors parallel.halo: one kernel per mesh axis,
-axis-ordered so edge/corner ghosts propagate (27-point stencil support);
-each kernel sends my low face to the low neighbor's high-ghost buffer and
-my high face to the high neighbor's low-ghost buffer, then waits for the
-symmetric receives. Non-periodic domain edges skip the send/recv and fill
-the ghost with the boundary value.
+axis-ordered so edge/corner ghosts propagate (27-point stencil support),
+width-k slabs so temporal blocking composes (k ghost rings per exchange).
+Faces are staged axis-leading — shape (k, A, B) with the two in-plane dims
+as the (sublane, lane) pair — the device-side analogue of the reference's
+pack kernels; staging is what keeps a width-k z-face from degenerating into
+a (nx, ny, k) buffer whose k-element minor dim would tile-pad to 128 lanes.
+Each kernel sends my low slab to the low neighbor's high-ghost buffer and
+my high slab to the high neighbor's low-ghost buffer, then waits for the
+symmetric receives. Non-periodic domain edges overwrite the ghost with the
+boundary value after the (torus-symmetric) transfers.
 """
 
 from __future__ import annotations
@@ -31,14 +36,14 @@ from jax.experimental.pallas import tpu as pltpu
 from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
 
 
-def _axis_exchange_kernel(
-    u_ref,
+def _slab_exchange_kernel(
+    lo_face,
+    hi_face,
     lo_ref,
     hi_ref,
     send_sem,
     recv_sem,
     *,
-    axis: int,
     axis_name: str,
     mesh_axes,
     size: int,
@@ -46,21 +51,12 @@ def _axis_exchange_kernel(
     bc_value: float,
     use_barrier: bool = True,
 ):
-    """Exchange ghost faces along one mesh axis via remote DMA.
+    """Exchange (k, A, B) ghost slabs along one mesh axis via remote DMA.
 
-    Runs as one program instance per device (no grid). ``u_ref`` stays in
-    ANY/HBM — faces are DMA'd straight out of it, never staged through a
-    pack buffer (the reference needs explicit pack/unpack kernels because
-    MPI wants contiguous buffers; a TPU DMA descriptor handles the strided
-    face natively).
+    Runs as one program instance per device (no grid). ``lo_face`` /
+    ``hi_face`` stay in ANY/HBM — the DMA descriptors read them directly.
     """
     my = lax.axis_index(axis_name)
-    n = u_ref.shape[axis]
-    # Integer-index the face axis away: faces are 2D (ny, nz)/(nx, nz)/(nx, ny)
-    # refs, so the ghost buffers tile VMEM as (8, 128) planes instead of
-    # carrying a size-1 dim into the tiled trailing pair.
-    idx_lo = tuple(0 if a == axis else slice(None) for a in range(3))
-    idx_hi = tuple(n - 1 if a == axis else slice(None) for a in range(3))
 
     def neighbor(delta):
         # Dict form of a MESH device id: only the communication axis moves.
@@ -92,16 +88,16 @@ def _axis_exchange_kernel(
             )
         pltpu.semaphore_wait(barrier, 2)
 
-    rdma_hi = pltpu.make_async_remote_copy(  # my high face -> hi nb's lo ghost
-        src_ref=u_ref.at[idx_hi],
+    rdma_hi = pltpu.make_async_remote_copy(  # my high slab -> hi nb's lo ghost
+        src_ref=hi_face,
         dst_ref=lo_ref,
         send_sem=send_sem.at[0],
         recv_sem=recv_sem.at[0],
         device_id=neighbor(+1),
         device_id_type=pltpu.DeviceIdType.MESH,
     )
-    rdma_lo = pltpu.make_async_remote_copy(  # my low face -> lo nb's hi ghost
-        src_ref=u_ref.at[idx_lo],
+    rdma_lo = pltpu.make_async_remote_copy(  # my low slab -> lo nb's hi ghost
+        src_ref=lo_face,
         dst_ref=hi_ref,
         send_sem=send_sem.at[1],
         recv_sem=recv_sem.at[1],
@@ -124,6 +120,26 @@ def _axis_exchange_kernel(
             hi_ref[...] = jnp.full(hi_ref.shape, bc_value, hi_ref.dtype)
 
 
+def _to_axis_leading(face: jax.Array, axis: int) -> jax.Array:
+    """Move the exchange axis to the front: (.., k at axis, ..) -> (k, A, B).
+    The device-side pack step (reference parity: the CUDA pack kernels that
+    feed MPI contiguous buffers — SURVEY.md §3.2)."""
+    if axis == 0:
+        return face
+    perm = (axis,) + tuple(a for a in range(3) if a != axis)
+    return jnp.transpose(face, perm)
+
+
+def _from_axis_leading(slab: jax.Array, axis: int) -> jax.Array:
+    if axis == 0:
+        return slab
+    inv = [0, 0, 0]
+    perm = (axis,) + tuple(a for a in range(3) if a != axis)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return jnp.transpose(slab, inv)
+
+
 def exchange_axis_dma(
     u: jax.Array,
     axis: int,
@@ -132,16 +148,20 @@ def exchange_axis_dma(
     mesh_axes,
     periodic: bool,
     bc_value: float = 0.0,
+    width: int = 1,
     interpret: bool = False,
 ) -> jax.Array:
-    """DMA-backed analogue of parallel.halo.exchange_axis: grow ``u`` by one
-    ghost layer along ``axis``, filled from mesh neighbors over ICI. Must
-    run inside shard_map."""
+    """DMA-backed analogue of parallel.halo.exchange_axis: grow ``u`` by
+    ``width`` ghost layers along ``axis``, filled from mesh neighbors over
+    ICI. Must run inside shard_map."""
+    n = u.shape[axis]
+    if width > n:
+        raise ValueError(f"halo width {width} > local extent {n} on axis {axis}")
     if axis_size == 1:
         # Degenerate ring: no remote party. Same semantics as the ppermute
         # path's special cases.
-        lo_face = lax.slice_in_dim(u, 0, 1, axis=axis)
-        hi_face = lax.slice_in_dim(u, u.shape[axis] - 1, u.shape[axis], axis=axis)
+        lo_face = lax.slice_in_dim(u, 0, width, axis=axis)
+        hi_face = lax.slice_in_dim(u, n - width, n, axis=axis)
         if periodic:
             ghost_lo, ghost_hi = hi_face, lo_face
         else:
@@ -149,11 +169,12 @@ def exchange_axis_dma(
             ghost_hi = jnp.full_like(hi_face, bc_value)
         return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
 
-    plane_shape = tuple(s for a, s in enumerate(u.shape) if a != axis)
-    slab_shape = tuple(1 if a == axis else s for a, s in enumerate(u.shape))
+    lo_face = _to_axis_leading(lax.slice_in_dim(u, 0, width, axis=axis), axis)
+    hi_face = _to_axis_leading(
+        lax.slice_in_dim(u, n - width, n, axis=axis), axis
+    )
     kernel = functools.partial(
-        _axis_exchange_kernel,
-        axis=axis,
+        _slab_exchange_kernel,
         axis_name=axis_name,
         mesh_axes=tuple(mesh_axes),
         size=axis_size,
@@ -163,14 +184,17 @@ def exchange_axis_dma(
     )
     ghost_lo, ghost_hi = pl.pallas_call(
         kernel,
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
         out_specs=(
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct(plane_shape, u.dtype),
-            jax.ShapeDtypeStruct(plane_shape, u.dtype),
+            jax.ShapeDtypeStruct(lo_face.shape, u.dtype),
+            jax.ShapeDtypeStruct(hi_face.shape, u.dtype),
         ),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA((2,)),
@@ -181,9 +205,13 @@ def exchange_axis_dma(
             collective_id=axis,
         ),
         interpret=interpret,
-    )(u)
+    )(lo_face, hi_face)
     return lax.concatenate(
-        [ghost_lo.reshape(slab_shape), u, ghost_hi.reshape(slab_shape)],
+        [
+            _from_axis_leading(ghost_lo, axis),
+            u,
+            _from_axis_leading(ghost_hi, axis),
+        ],
         dimension=axis,
     )
 
@@ -193,11 +221,13 @@ def exchange_halo_dma(
     mesh_cfg: MeshConfig,
     bc: BoundaryCondition,
     bc_value: float = 0.0,
+    width: int = 1,
     interpret: bool = False,
 ) -> jax.Array:
-    """Full 3D DMA ghost exchange: local (nx,ny,nz) -> (nx+2,ny+2,nz+2).
-    Axis-ordered like the ppermute path so corner ghosts propagate. Must run
-    inside shard_map over the mesh in ``mesh_cfg``."""
+    """Full 3D DMA ghost exchange: local (nx,ny,nz) -> (nx+2w,ny+2w,nz+2w).
+    Axis-ordered like the ppermute path so corner ghosts propagate (each
+    later axis exchanges the already-ghost-grown slab). Must run inside
+    shard_map over the mesh in ``mesh_cfg``."""
     periodic = bc is BoundaryCondition.PERIODIC
     for axis, (axis_name, axis_size) in enumerate(
         zip(mesh_cfg.axis_names, mesh_cfg.shape)
@@ -210,6 +240,7 @@ def exchange_halo_dma(
             mesh_cfg.axis_names,
             periodic,
             bc_value,
+            width=width,
             interpret=interpret,
         )
     return u

@@ -105,12 +105,21 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
         and not os.environ.get("HEAT3D_NO_DIRECT")
     ):
         # same gate as parallel.step._direct_kernel_fn: only report the
-        # direct kernel as support when the dispatch will actually take it,
-        # else large single-shard configs would trace into the (infeasible)
+        # direct kernel as support when the dispatch will actually take it
+        # for EVERY step shape this config runs (tb>=3 supersteps fall back
+        # to the padded compute, so they can't ride the direct kernel), else
+        # large single-shard configs would trace into the (infeasible)
         # windowed kernel instead of falling back
         from heat3d_tpu.ops.stencil_pallas_direct import direct_supported
 
-        if direct_supported(cfg.local_shape, 1, itemsize, itemsize):
+        d1 = direct_supported(cfg.local_shape, 1, itemsize, itemsize)
+        if cfg.time_blocking == 1 and d1:
+            return True, ""
+        if (
+            cfg.time_blocking == 2
+            and d1
+            and direct_supported(cfg.local_shape, 2, itemsize, itemsize)
+        ):
             return True, ""
     if stream_supported(cfg.local_shape, itemsize, itemsize):
         return True, ""  # streaming kernel: no Element windows needed

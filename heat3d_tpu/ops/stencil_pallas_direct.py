@@ -90,6 +90,8 @@ def choose_chunk(
             continue
         if ny >= 8 and by % 8:
             continue
+        if halo == 2 and by % 2:
+            continue  # (1,2,nz) ghost-row blocks need even element offsets
         if _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize) <= _VMEM_BUDGET:
             return by
     return None
@@ -429,8 +431,8 @@ def apply_taps_direct2(
         grid=(n_chunks, nx + 4),
         in_specs=[
             pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0)),
-            # width-2 ghost-row blocks; 2-row blocks need even offsets,
-            # guaranteed by by % 8 == 0 (or the index maps' even clamps)
+            # width-2 ghost-row blocks; 2-row blocks need even element
+            # offsets, guaranteed by choose_chunk's even-by rule for halo=2
             pl.BlockSpec((1, 2, nz), lambda j, i: (x_of(i), top_of(j), 0)),
             pl.BlockSpec((1, 2, nz), lambda j, i: (x_of(i), bot_of(j), 0)),
         ],

@@ -71,10 +71,9 @@ def exchange(
     if cfg.halo == "dma":
         from heat3d_tpu.ops.halo_pallas import exchange_halo_dma
 
-        if width != 1:
-            raise NotImplementedError("halo='dma' supports width=1 only")
         return exchange_halo_dma(
-            u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value
+            u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value,
+            width=width,
         )
     return exchange_halo(
         u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value, width
@@ -311,10 +310,6 @@ def make_superstep_fn(
     """Build the sharded temporally-blocked superstep ``u -> u_after_k_steps``
     for ``k = cfg.time_blocking`` (see _local_stepk). Requires ppermute
     halo, no overlap split, and local extents >= k."""
-    if cfg.halo == "dma":
-        raise ValueError(
-            f"time_blocking={cfg.time_blocking} requires halo='ppermute'"
-        )
     if cfg.overlap:
         raise ValueError(
             f"time_blocking={cfg.time_blocking} and overlap=True are "
