@@ -73,6 +73,11 @@ def main() -> int:
             raise
         # judged floor doesn't fit this chip's HBM: record the 512^3 number
         edge, fell_back = 512, True
+        r = None
+    if r is None:
+        # retried OUTSIDE the except block: the handler's traceback would
+        # otherwise pin the OOM'd attempt's frames (and device buffers)
+        # through the rerun
         r = _run(edge, steps, dtype, backend, time_blocking)
 
     gcells = r["gcell_per_sec_per_chip"]

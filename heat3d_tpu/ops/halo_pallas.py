@@ -120,6 +120,124 @@ def _slab_exchange_kernel(
             hi_ref[...] = jnp.full(hi_ref.shape, bc_value, hi_ref.dtype)
 
 
+def _face_exchange_kernel(
+    u_ref,
+    lo_ref,
+    hi_ref,
+    send_sem,
+    recv_sem,
+    *,
+    axis: int,
+    axis_name: str,
+    mesh_axes,
+    size: int,
+    periodic: bool,
+    bc_value: float,
+    use_barrier: bool = True,
+):
+    """Width-1 fast path: exchange single ghost faces along one mesh axis,
+    DMA-ing them STRAIGHT out of the ANY/HBM-resident ``u_ref`` — no pack
+    staging at all (the closest analogue of CUDA-aware MPI's zero-staging
+    device-pointer sends; a TPU DMA descriptor handles the strided face
+    natively). Faces are integer-indexed to 2D (A, B) refs so the ghost
+    buffers tile VMEM as (8, 128) planes with no size-1 dim in the tiled
+    trailing pair."""
+    my = lax.axis_index(axis_name)
+    n = u_ref.shape[axis]
+    idx_lo = tuple(0 if a == axis else slice(None) for a in range(3))
+    idx_hi = tuple(n - 1 if a == axis else slice(None) for a in range(3))
+
+    def neighbor(delta):
+        idx = lax.rem(my + delta + size, size)
+        if len(mesh_axes) == 1:
+            return idx
+        return {axis_name: idx}
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        for delta in (-1, +1):
+            pltpu.semaphore_signal(
+                barrier,
+                inc=1,
+                device_id=neighbor(delta),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+        pltpu.semaphore_wait(barrier, 2)
+
+    rdma_hi = pltpu.make_async_remote_copy(
+        src_ref=u_ref.at[idx_hi],
+        dst_ref=lo_ref,
+        send_sem=send_sem.at[0],
+        recv_sem=recv_sem.at[0],
+        device_id=neighbor(+1),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    rdma_lo = pltpu.make_async_remote_copy(
+        src_ref=u_ref.at[idx_lo],
+        dst_ref=hi_ref,
+        send_sem=send_sem.at[1],
+        recv_sem=recv_sem.at[1],
+        device_id=neighbor(-1),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    rdma_hi.start()
+    rdma_lo.start()
+    rdma_hi.wait()
+    rdma_lo.wait()
+
+    if not periodic:
+
+        @pl.when(my == 0)
+        def _fill_lo():
+            lo_ref[...] = jnp.full(lo_ref.shape, bc_value, lo_ref.dtype)
+
+        @pl.when(my == size - 1)
+        def _fill_hi():
+            hi_ref[...] = jnp.full(hi_ref.shape, bc_value, hi_ref.dtype)
+
+
+def _exchange_axis_dma_width1(
+    u, axis, axis_name, axis_size, mesh_axes, periodic, bc_value, interpret
+):
+    plane_shape = tuple(s for a, s in enumerate(u.shape) if a != axis)
+    slab_shape = tuple(1 if a == axis else s for a, s in enumerate(u.shape))
+    kernel = functools.partial(
+        _face_exchange_kernel,
+        axis=axis,
+        axis_name=axis_name,
+        mesh_axes=tuple(mesh_axes),
+        size=axis_size,
+        periodic=periodic,
+        bc_value=bc_value,
+        use_barrier=not interpret,
+    )
+    ghost_lo, ghost_hi = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(plane_shape, u.dtype),
+            jax.ShapeDtypeStruct(plane_shape, u.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=axis,
+        ),
+        interpret=interpret,
+    )(u)
+    return lax.concatenate(
+        [ghost_lo.reshape(slab_shape), u, ghost_hi.reshape(slab_shape)],
+        dimension=axis,
+    )
+
+
 def _to_axis_leading(face: jax.Array, axis: int) -> jax.Array:
     """Move the exchange axis to the front: (.., k at axis, ..) -> (k, A, B).
     The device-side pack step (reference parity: the CUDA pack kernels that
@@ -168,6 +286,13 @@ def exchange_axis_dma(
             ghost_lo = jnp.full_like(lo_face, bc_value)
             ghost_hi = jnp.full_like(hi_face, bc_value)
         return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
+
+    if width == 1:
+        # zero-staging fast path: faces DMA'd straight out of u
+        return _exchange_axis_dma_width1(
+            u, axis, axis_name, axis_size, mesh_axes, periodic, bc_value,
+            interpret,
+        )
 
     lo_face = _to_axis_leading(lax.slice_in_dim(u, 0, width, axis=axis), axis)
     hi_face = _to_axis_leading(

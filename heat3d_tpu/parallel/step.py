@@ -308,8 +308,9 @@ def make_superstep_fn(
     compute_padded: LocalCompute = apply_taps_padded,
 ):
     """Build the sharded temporally-blocked superstep ``u -> u_after_k_steps``
-    for ``k = cfg.time_blocking`` (see _local_stepk). Requires ppermute
-    halo, no overlap split, and local extents >= k."""
+    for ``k = cfg.time_blocking`` (see _local_stepk). Composes with either
+    halo transport (ppermute or the width-k DMA slab exchange); requires no
+    overlap split and local extents >= k."""
     if cfg.overlap:
         raise ValueError(
             f"time_blocking={cfg.time_blocking} and overlap=True are "

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Capture a jax.profiler trace of the throughput bench and summarize the
+# device-time breakdown (VERDICT r1 item 2: attribute the roofline gap with
+# a trace, not guesses).
+#
+# Usage: [GRID=512] [STEPS=20] [TB=1] [DTYPE=fp32] scripts/profile_bench.sh [outdir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-/tmp/heat3d_profile}"
+GRID="${GRID:-512}"
+STEPS="${STEPS:-20}"
+TB="${TB:-1}"
+DTYPE="${DTYPE:-fp32}"
+
+rm -rf "$OUT"
+python -m heat3d_tpu.bench --grid "$GRID" --steps "$STEPS" \
+  --time-blocking "$TB" --dtype "$DTYPE" --mesh 1 1 1 \
+  --bench throughput --profile-dir "$OUT"
+
+python scripts/summarize_trace.py "$OUT"

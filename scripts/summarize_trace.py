@@ -1,0 +1,74 @@
+"""Summarize a jax.profiler trace directory: per-op device time.
+
+Reads the xplane protobuf the profiler writes and prints the top device ops
+by total self time — enough to attribute a roofline gap (DMA wait vs
+compute vs dispatch gaps) without shipping the trace to TensorBoard.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+from collections import defaultdict
+
+
+def find_xplane(logdir: str):
+    pats = os.path.join(logdir, "**", "*.xplane.pb")
+    files = sorted(glob.glob(pats, recursive=True))
+    return files[-1] if files else None
+
+
+def summarize(path: str) -> int:
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
+    except ImportError:
+        print(
+            "no xplane_pb2 available; open the trace in TensorBoard "
+            f"(tensorboard --logdir {os.path.dirname(path)})"
+        )
+        return 1
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    planes = [
+        p
+        for p in xs.planes
+        if "TPU" in p.name or "/device" in p.name.lower()
+    ]
+    if not planes:  # CPU-only trace: fall back to the host plane
+        planes = [p for p in xs.planes if p.lines]
+    for plane in planes:
+        totals = defaultdict(float)
+        counts = defaultdict(int)
+        for line in plane.lines:
+            for ev in line.events:
+                meta = plane.event_metadata[ev.metadata_id]
+                dur_us = ev.duration_ps / 1e6
+                totals[meta.name] += dur_us
+                counts[meta.name] += 1
+        if not totals:
+            continue
+        print(f"\n== {plane.name} (total {sum(totals.values())/1e3:.2f} ms)")
+        for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[:25]:
+            print(f"  {us/1e3:9.3f} ms  x{counts[name]:<6} {name[:90]}")
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    path = sys.argv[1]
+    if os.path.isdir(path):
+        xp = find_xplane(path)
+        if xp is None:
+            print(f"no .xplane.pb under {path}")
+            return 1
+        path = xp
+    print(f"trace: {path}")
+    return summarize(path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

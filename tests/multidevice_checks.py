@@ -296,8 +296,8 @@ def check_multistep_vs_golden():
 
 def check_dma_halo_ring_interpret():
     """Pallas RDMA halo exchange (interpret mode) on a real 8-device ring ==
-    the ppermute exchange, for every array axis (exercising the axis-leading
-    face staging) and ghost widths 1..3, periodic and Dirichlet.
+    the ppermute exchange, for every array axis (width-1 zero-staging fast
+    path and axis-leading slab staging alike) and ghost widths 1..3, periodic and Dirichlet.
 
     jax 0.9's interpret mode cannot discharge remote DMA on meshes with >1
     named axis (dma_start_p NotImplementedError, MESH and LOGICAL device-id
@@ -311,13 +311,13 @@ def check_dma_halo_ring_interpret():
     from heat3d_tpu.parallel.halo import exchange_axis
 
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
-    base = (16, 16, 16)
+    base = (24, 24, 24)  # 3 cells/shard on the ring axis: admits width 3
     u_host = golden.random_init(base, seed=3)
     for axis in range(3):
         spec = P(*["x" if a == axis else None for a in range(3)])
         u = jax.device_put(jnp.asarray(u_host), NamedSharding(mesh, spec))
         for periodic in (True, False):
-            for width in (1, 2):
+            for width in (1, 2, 3):
                 got = jax.jit(
                     jax.shard_map(
                         lambda x: exchange_axis_dma(
@@ -341,7 +341,7 @@ def check_dma_halo_ring_interpret():
                     np.asarray(got), np.asarray(want),
                     err_msg=f"axis={axis} periodic={periodic} width={width}",
                 )
-    print("dma_halo_ring_interpret OK (axes 0-2, widths 1-2)")
+    print("dma_halo_ring_interpret OK (axes 0-2, widths 1-3)")
 
 
 def check_sharded_checkpoint_roundtrip():
