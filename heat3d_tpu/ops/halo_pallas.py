@@ -36,9 +36,9 @@ from jax.experimental.pallas import tpu as pltpu
 from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
 
 
-def _slab_exchange_kernel(
-    lo_face,
-    hi_face,
+def _exchange_body(
+    src_lo,
+    src_hi,
     lo_ref,
     hi_ref,
     send_sem,
@@ -49,13 +49,18 @@ def _slab_exchange_kernel(
     size: int,
     periodic: bool,
     bc_value: float,
-    use_barrier: bool = True,
+    use_barrier: bool,
 ):
-    """Exchange (k, A, B) ghost slabs along one mesh axis via remote DMA.
+    """Shared ring-exchange body: push ``src_hi`` to the high neighbor's
+    low-ghost buffer and ``src_lo`` to the low neighbor's high-ghost buffer,
+    then wait for the symmetric receives. Sources stay in ANY/HBM — the DMA
+    descriptors read them directly (strided faces included).
 
-    Runs as one program instance per device (no grid). ``lo_face`` /
-    ``hi_face`` stay in ANY/HBM — the DMA descriptors read them directly.
-    """
+    Every device exchanges ring-wise in both directions, including the
+    domain-edge wrap (the ICI torus has those links anyway); non-periodic
+    edge ghosts are overwritten with the BC value afterwards. Keeping the
+    transfer pattern fully symmetric avoids conditional DMAs, which both
+    Mosaic's collective matching and interpret mode handle poorly."""
     my = lax.axis_index(axis_name)
 
     def neighbor(delta):
@@ -66,12 +71,6 @@ def _slab_exchange_kernel(
         if len(mesh_axes) == 1:
             return idx
         return {axis_name: idx}
-
-    # Every device exchanges ring-wise in both directions, including the
-    # domain-edge wrap (the ICI torus has those links anyway); non-periodic
-    # edge ghosts are overwritten with the BC value afterwards. Keeping the
-    # transfer pattern fully symmetric avoids conditional DMAs, which both
-    # Mosaic's collective matching and interpret mode handle poorly.
 
     # Neighbor barrier: nobody starts pushing into a peer's ghost buffers
     # until that peer has entered this kernel (guards against cross-call
@@ -88,16 +87,16 @@ def _slab_exchange_kernel(
             )
         pltpu.semaphore_wait(barrier, 2)
 
-    rdma_hi = pltpu.make_async_remote_copy(  # my high slab -> hi nb's lo ghost
-        src_ref=hi_face,
+    rdma_hi = pltpu.make_async_remote_copy(  # my high face -> hi nb's lo ghost
+        src_ref=src_hi,
         dst_ref=lo_ref,
         send_sem=send_sem.at[0],
         recv_sem=recv_sem.at[0],
         device_id=neighbor(+1),
         device_id_type=pltpu.DeviceIdType.MESH,
     )
-    rdma_lo = pltpu.make_async_remote_copy(  # my low slab -> lo nb's hi ghost
-        src_ref=lo_face,
+    rdma_lo = pltpu.make_async_remote_copy(  # my low face -> lo nb's hi ghost
+        src_ref=src_lo,
         dst_ref=hi_ref,
         send_sem=send_sem.at[1],
         recv_sem=recv_sem.at[1],
@@ -120,80 +119,29 @@ def _slab_exchange_kernel(
             hi_ref[...] = jnp.full(hi_ref.shape, bc_value, hi_ref.dtype)
 
 
-def _face_exchange_kernel(
-    u_ref,
-    lo_ref,
-    hi_ref,
-    send_sem,
-    recv_sem,
-    *,
-    axis: int,
-    axis_name: str,
-    mesh_axes,
-    size: int,
-    periodic: bool,
-    bc_value: float,
-    use_barrier: bool = True,
-):
-    """Width-1 fast path: exchange single ghost faces along one mesh axis,
-    DMA-ing them STRAIGHT out of the ANY/HBM-resident ``u_ref`` — no pack
-    staging at all (the closest analogue of CUDA-aware MPI's zero-staging
-    device-pointer sends; a TPU DMA descriptor handles the strided face
-    natively). Faces are integer-indexed to 2D (A, B) refs so the ghost
-    buffers tile VMEM as (8, 128) planes with no size-1 dim in the tiled
-    trailing pair."""
-    my = lax.axis_index(axis_name)
+def _slab_exchange_kernel(lo_face, hi_face, lo_ref, hi_ref, send_sem,
+                          recv_sem, **kw):
+    """Width-k path: exchange pre-staged axis-leading (k, A, B) slabs."""
+    _exchange_body(
+        lo_face, hi_face, lo_ref, hi_ref, send_sem, recv_sem, **kw
+    )
+
+
+def _face_exchange_kernel(u_ref, lo_ref, hi_ref, send_sem, recv_sem, *,
+                          axis: int, **kw):
+    """Width-1 fast path: DMA single ghost faces STRAIGHT out of the
+    ANY/HBM-resident ``u_ref`` — no pack staging at all (the closest
+    analogue of CUDA-aware MPI's zero-staging device-pointer sends; a TPU
+    DMA descriptor handles the strided face natively). Faces are
+    integer-indexed to 2D (A, B) refs so the ghost buffers tile VMEM as
+    (8, 128) planes with no size-1 dim in the tiled trailing pair."""
     n = u_ref.shape[axis]
     idx_lo = tuple(0 if a == axis else slice(None) for a in range(3))
     idx_hi = tuple(n - 1 if a == axis else slice(None) for a in range(3))
-
-    def neighbor(delta):
-        idx = lax.rem(my + delta + size, size)
-        if len(mesh_axes) == 1:
-            return idx
-        return {axis_name: idx}
-
-    if use_barrier:
-        barrier = pltpu.get_barrier_semaphore()
-        for delta in (-1, +1):
-            pltpu.semaphore_signal(
-                barrier,
-                inc=1,
-                device_id=neighbor(delta),
-                device_id_type=pltpu.DeviceIdType.MESH,
-            )
-        pltpu.semaphore_wait(barrier, 2)
-
-    rdma_hi = pltpu.make_async_remote_copy(
-        src_ref=u_ref.at[idx_hi],
-        dst_ref=lo_ref,
-        send_sem=send_sem.at[0],
-        recv_sem=recv_sem.at[0],
-        device_id=neighbor(+1),
-        device_id_type=pltpu.DeviceIdType.MESH,
+    _exchange_body(
+        u_ref.at[idx_lo], u_ref.at[idx_hi], lo_ref, hi_ref, send_sem,
+        recv_sem, **kw,
     )
-    rdma_lo = pltpu.make_async_remote_copy(
-        src_ref=u_ref.at[idx_lo],
-        dst_ref=hi_ref,
-        send_sem=send_sem.at[1],
-        recv_sem=recv_sem.at[1],
-        device_id=neighbor(-1),
-        device_id_type=pltpu.DeviceIdType.MESH,
-    )
-    rdma_hi.start()
-    rdma_lo.start()
-    rdma_hi.wait()
-    rdma_lo.wait()
-
-    if not periodic:
-
-        @pl.when(my == 0)
-        def _fill_lo():
-            lo_ref[...] = jnp.full(lo_ref.shape, bc_value, lo_ref.dtype)
-
-        @pl.when(my == size - 1)
-        def _fill_hi():
-            hi_ref[...] = jnp.full(hi_ref.shape, bc_value, hi_ref.dtype)
 
 
 def _exchange_axis_dma_width1(

@@ -23,11 +23,13 @@ def summarize(path: str) -> int:
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
     except ImportError:
+        # soft fallback: the capture itself succeeded, so don't fail the
+        # calling script — just point at the trace
         print(
             "no xplane_pb2 available; open the trace in TensorBoard "
             f"(tensorboard --logdir {os.path.dirname(path)})"
         )
-        return 1
+        return 0
     xs = xplane_pb2.XSpace()
     with open(path, "rb") as f:
         xs.ParseFromString(f.read())
@@ -39,17 +41,28 @@ def summarize(path: str) -> int:
     if not planes:  # CPU-only trace: fall back to the host plane
         planes = [p for p in xs.planes if p.lines]
     for plane in planes:
+        # A device plane carries several lines covering the SAME wall time
+        # (XLA Modules / XLA Ops / Steps); summing across them would double-
+        # count. Aggregate one line only: the op-level line if present, else
+        # the busiest line.
+        def line_us(line):
+            return sum(ev.duration_ps for ev in line.events) / 1e6
+
+        lines = [ln for ln in plane.lines if ln.events]
+        if not lines:
+            continue
+        ops = [ln for ln in lines if "op" in ln.name.lower()]
+        line = ops[0] if ops else max(lines, key=line_us)
         totals = defaultdict(float)
         counts = defaultdict(int)
-        for line in plane.lines:
-            for ev in line.events:
-                meta = plane.event_metadata[ev.metadata_id]
-                dur_us = ev.duration_ps / 1e6
-                totals[meta.name] += dur_us
-                counts[meta.name] += 1
-        if not totals:
-            continue
-        print(f"\n== {plane.name} (total {sum(totals.values())/1e3:.2f} ms)")
+        for ev in line.events:
+            meta = plane.event_metadata[ev.metadata_id]
+            totals[meta.name] += ev.duration_ps / 1e6
+            counts[meta.name] += 1
+        print(
+            f"\n== {plane.name} [line: {line.name or '?'}] "
+            f"(total {sum(totals.values())/1e3:.2f} ms)"
+        )
         for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[:25]:
             print(f"  {us/1e3:9.3f} ms  x{counts[name]:<6} {name[:90]}")
     return 0

@@ -1,0 +1,148 @@
+"""Analytic physics tier: discrete eigenmode decay.
+
+Product-sine modes are exact eigenvectors of the periodic discrete
+Laplacian (any symmetric tap set), so one stencil update scales the mode by
+a constant eigenvalue mu and s updates scale it by mu^s. Unlike the golden
+comparisons (which check the implementation against itself in float64),
+this checks the whole compiled path against closed-form math — taps, dt,
+spacing, and the time loop all have to be right for exponential decay to
+hold. Reference parity: the serial-reference residual-decay check
+(SURVEY.md §4, §3.4), strengthened to an exact statement."""
+
+import numpy as np
+import pytest
+
+from heat3d_tpu.core import golden
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.models.heat3d import HeatSolver3D
+
+
+def _sine_mode(shape, modes=(1, 2, 1)):
+    """Product-sine eigenmode, float64 (fp32 rounding would perturb the
+    exact eigenvector property by ~1e-8)."""
+    nx, ny, nz = shape
+    x = np.arange(nx) * 2 * np.pi * modes[0] / nx
+    y = np.arange(ny) * 2 * np.pi * modes[1] / ny
+    z = np.arange(nz) * 2 * np.pi * modes[2] / nz
+    return (
+        np.sin(x)[:, None, None]
+        * np.sin(y)[None, :, None]
+        * np.sin(z)[None, None, :]
+    )
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize("spacing", [(1.0, 1.0, 1.0), (1.0, 0.5, 2.0)])
+def test_periodic_sine_mode_is_eigenvector(kind, spacing):
+    if kind == "27pt" and len(set(spacing)) > 1:
+        pytest.skip("27pt requires uniform spacing (framework constraint)")
+    shape = (16, 16, 16)
+    cfg_grid = GridConfig(shape=shape, spacing=spacing)
+    stencil = StencilConfig(kind=kind, bc=BoundaryCondition.PERIODIC)
+    u0 = _sine_mode(shape)
+    u1 = golden.run(u0, cfg_grid, stencil, 1)
+    # eigenvalue: the pointwise ratio is constant wherever u0 isn't ~0
+    mask = np.abs(u0) > 0.3
+    ratios = u1[mask] / u0[mask]
+    mu = ratios.mean()
+    assert ratios.std() < 1e-12, f"not an eigenvector: std={ratios.std()}"
+    assert 0.0 < mu < 1.0, f"heat must decay: mu={mu}"
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize("tb", [1, 2])
+def test_solver_decays_sine_mode_analytically(kind, tb):
+    """s compiled updates == mu^s times the initial mode (fp32 tolerance),
+    through the full sharded solver path including temporal blocking."""
+    shape = (16, 16, 16)
+    steps = 6
+    cfg = SolverConfig(
+        grid=GridConfig(shape=shape),
+        stencil=StencilConfig(kind=kind, bc=BoundaryCondition.PERIODIC),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp",
+        time_blocking=tb,
+    )
+    u0 = _sine_mode(shape)
+    u1 = golden.run(u0, cfg.grid, cfg.stencil, 1)
+    mask = np.abs(u0) > 0.3
+    mu = float((u1[mask] / u0[mask]).mean())
+
+    solver = HeatSolver3D(cfg)
+    got = solver.gather(solver.run(solver.init_state(u0.astype(np.float32)), steps))
+    want = (mu**steps) * u0
+    np.testing.assert_allclose(got, want, rtol=5e-5, atol=1e-6)
+
+
+def test_dirichlet_sine_mode_decay():
+    """Dirichlet eigenmodes: sin(pi m (i+1)/(N+1)) vanishes at the ghost
+    boundary (i = -1 and i = N), so it is an eigenvector of the
+    zero-Dirichlet operator too."""
+    shape = (15, 15, 15)  # N+1 = 16 keeps the mode exactly representable
+
+    def mode1d(n):
+        return np.sin(np.pi * 1 * (np.arange(n) + 1) / (n + 1))
+
+    u0 = (
+        mode1d(15)[:, None, None]
+        * mode1d(15)[None, :, None]
+        * mode1d(15)[None, None, :]
+    )
+    cfg = SolverConfig(
+        grid=GridConfig(shape=shape),
+        stencil=StencilConfig(kind="7pt", bc=BoundaryCondition.DIRICHLET),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp",
+    )
+    u1 = golden.run(u0, cfg.grid, cfg.stencil, 1)
+    mask = np.abs(u0) > 0.3
+    ratios = u1[mask] / u0[mask]
+    mu = ratios.mean()
+    assert ratios.std() < 1e-12
+    steps = 5
+    solver = HeatSolver3D(cfg)
+    got = solver.gather(solver.run(solver.init_state(u0.astype(np.float32)), steps))
+    np.testing.assert_allclose(
+        got, (mu**steps) * u0, rtol=5e-5, atol=1e-6
+    )
+
+
+def test_stability_bound_honored():
+    """The default dt (0.9x the stable limit) must keep every periodic mode
+    bounded: |mu| <= 1 for the worst (Nyquist) mode."""
+    shape = (8, 8, 8)
+    cfg_grid = GridConfig(shape=shape)
+    stencil = StencilConfig(kind="7pt", bc=BoundaryCondition.PERIODIC)
+    # Nyquist checkerboard: the fastest-decaying mode
+    idx = np.indices(shape).sum(axis=0)
+    u0 = ((-1.0) ** idx).astype(np.float64)
+    u1 = golden.run(u0, cfg_grid, stencil, 1)
+    mu = (u1 / u0).mean()
+    assert np.abs(mu) <= 1.0, f"unstable dt: checkerboard mu={mu}"
+
+
+def test_total_heat_conserved_periodic():
+    """With periodic BCs the discrete update conserves the field sum exactly
+    in exact arithmetic (the taps sum to 1 and every shift is a permutation)
+    — checked in float64 on the golden stepper and to fp32 rounding on the
+    compiled solver."""
+    shape = (12, 12, 12)
+    rng = np.random.default_rng(5)
+    u0 = rng.standard_normal(shape)
+    cfg = SolverConfig(
+        grid=GridConfig(shape=shape),
+        stencil=StencilConfig(kind="27pt", bc=BoundaryCondition.PERIODIC),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp",
+    )
+    u5 = golden.run(u0, cfg.grid, cfg.stencil, 5)
+    assert u5.sum() == pytest.approx(u0.sum(), abs=1e-9)
+    solver = HeatSolver3D(cfg)
+    got = solver.gather(solver.run(solver.init_state(u0.astype(np.float32)), 5))
+    assert float(got.sum()) == pytest.approx(float(u0.astype(np.float32).sum()), abs=1e-2)
