@@ -16,10 +16,12 @@ drops to the streaming minimum (one read + one write per cell per update;
 the fused two-step variant halves that again), which is the whole game for
 a 7/27-point stencil at ~8 B/cell.
 
-Scope: a shard whose mesh is (1, 1, 1) — i.e. every boundary is a DOMAIN
-boundary (the judged single-chip benchmark config, and any axis-size-1
-shard_map axis). Multi-device shards keep the exchange+kernel path, whose
-ICI ghosts these kernels cannot synthesize locally.
+Scope: the in-kernel ghost synthesis is exact where a boundary is a DOMAIN
+boundary — the whole shard on a (1, 1, 1) mesh (the judged single-chip
+benchmark config) and every axis-size-1 shard_map axis. On multi-chip
+meshes these kernels still sweep the bulk (parallel.step's faces-direct
+step): the outermost shell of each sharded axis, where the local synthesis
+is wrong, is recomputed from the exchanged ghost faces and patched in.
 
 Layout: the local (nx, ny, nz) volume is walked as a 2D Pallas grid
 (J, nx + 2k) — y-chunk-column outer (J = ny/by picked to fit VMEM), x-plane

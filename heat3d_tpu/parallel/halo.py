@@ -60,29 +60,46 @@ def exchange_axis(
         )
     lo_face = lax.slice_in_dim(u, 0, width, axis=axis)
     hi_face = lax.slice_in_dim(u, n - width, n, axis=axis)
+    ghost_lo, ghost_hi = axis_ghosts(
+        lo_face, hi_face, axis_name, axis_size, periodic, bc_value
+    )
+    return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
 
+
+def axis_ghosts(
+    lo_face: jax.Array,
+    hi_face: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    periodic: bool,
+    bc_value: float = 0.0,
+):
+    """The communication core of one axis exchange: given my two boundary
+    faces, return my two ghost faces (neighbor data, wrap, or the BC).
+    Must run inside shard_map."""
     if axis_size == 1 and periodic:
         # self-wrap: my own faces are my ghosts
-        ghost_lo, ghost_hi = hi_face, lo_face
-    elif axis_size == 1:
-        ghost_lo = jnp.full_like(lo_face, bc_value)
-        ghost_hi = jnp.full_like(hi_face, bc_value)
-    else:
-        # my low ghost = low neighbor's high face: shift high faces "up" (+1)
-        ghost_lo = lax.ppermute(
-            hi_face, axis_name, _shift_perm(axis_size, +1, periodic)
+        return hi_face, lo_face
+    if axis_size == 1:
+        return (
+            jnp.full_like(lo_face, bc_value),
+            jnp.full_like(hi_face, bc_value),
         )
-        # my high ghost = high neighbor's low face: shift low faces "down" (-1)
-        ghost_hi = lax.ppermute(
-            lo_face, axis_name, _shift_perm(axis_size, -1, periodic)
+    # my low ghost = low neighbor's high face: shift high faces "up" (+1)
+    ghost_lo = lax.ppermute(
+        hi_face, axis_name, _shift_perm(axis_size, +1, periodic)
+    )
+    # my high ghost = high neighbor's low face: shift low faces "down" (-1)
+    ghost_hi = lax.ppermute(
+        lo_face, axis_name, _shift_perm(axis_size, -1, periodic)
+    )
+    if not periodic and bc_value != 0.0:
+        idx = lax.axis_index(axis_name)
+        ghost_lo = jnp.where(idx == 0, jnp.full_like(ghost_lo, bc_value), ghost_lo)
+        ghost_hi = jnp.where(
+            idx == axis_size - 1, jnp.full_like(ghost_hi, bc_value), ghost_hi
         )
-        if not periodic and bc_value != 0.0:
-            idx = lax.axis_index(axis_name)
-            ghost_lo = jnp.where(idx == 0, jnp.full_like(ghost_lo, bc_value), ghost_lo)
-            ghost_hi = jnp.where(
-                idx == axis_size - 1, jnp.full_like(ghost_hi, bc_value), ghost_hi
-            )
-    return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
+    return ghost_lo, ghost_hi
 
 
 def exchange_halo(
@@ -104,3 +121,44 @@ def exchange_halo(
             u, axis, axis_name, axis_size, periodic, bc_value, width
         )
     return u
+
+
+def exchange_halo_faces(
+    u: jax.Array,
+    mesh_cfg: MeshConfig,
+    bc: BoundaryCondition,
+    bc_value: float = 0.0,
+):
+    """Faces-only ghost exchange: the six width-1 ghost faces of the
+    axis-ordered exchange WITHOUT materializing the padded volume (whose
+    concatenate is a full read+write of the field — the dominant HBM cost
+    of the exchange path; see ops/stencil_pallas_direct.py).
+
+    Returns ``(xlo, xhi, ylo, yhi, zlo, zhi)`` with the progressive
+    extension the axis ordering implies: x faces are raw (1, ny, nz), y
+    faces x-extended (nx+2, 1, nz), z faces x+y-extended (nx+2, ny+2, 1) —
+    exactly the slices the padded array would have, corners included (the
+    later-axis send faces are built by concatenating the earlier ghosts
+    onto the boundary slice, which is how corner data propagates here).
+    Must run inside shard_map over the mesh in ``mesh_cfg``."""
+    periodic = bc is BoundaryCondition.PERIODIC
+    names, sizes = mesh_cfg.axis_names, mesh_cfg.shape
+
+    xlo, xhi = axis_ghosts(
+        u[:1], u[-1:], names[0], sizes[0], periodic, bc_value
+    )
+    # y send faces carry the x ghosts (corner propagation)
+    y_lo_send = lax.concatenate([xlo[:, :1], u[:, :1], xhi[:, :1]], 0)
+    y_hi_send = lax.concatenate([xlo[:, -1:], u[:, -1:], xhi[:, -1:]], 0)
+    ylo, yhi = axis_ghosts(
+        y_lo_send, y_hi_send, names[1], sizes[1], periodic, bc_value
+    )
+    # z send faces carry the x AND y ghosts
+    mid_lo = lax.concatenate([xlo[:, :, :1], u[:, :, :1], xhi[:, :, :1]], 0)
+    mid_hi = lax.concatenate([xlo[:, :, -1:], u[:, :, -1:], xhi[:, :, -1:]], 0)
+    z_lo_send = lax.concatenate([ylo[:, :, :1], mid_lo, yhi[:, :, :1]], 1)
+    z_hi_send = lax.concatenate([ylo[:, :, -1:], mid_hi, yhi[:, :, -1:]], 1)
+    zlo, zhi = axis_ghosts(
+        z_lo_send, z_hi_send, names[2], sizes[2], periodic, bc_value
+    )
+    return xlo, xhi, ylo, yhi, zlo, zhi

@@ -137,10 +137,16 @@ def test_dispatch_used_on_111_mesh(monkeypatch):
     monkeypatch.setenv("HEAT3D_NO_DIRECT", "1")
     assert _direct_kernel_fn(cfg, 1) is None
     monkeypatch.delenv("HEAT3D_NO_DIRECT")
-    # never off a (1,1,1) mesh or under overlap/jnp backend
+    # plain dispatch never fires off a (1,1,1) mesh (multi-chip goes through
+    # the faces-direct step, which passes multichip=True), nor under
+    # overlap/jnp backend
     assert _direct_kernel_fn(
         dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 1, 1))), 1
     ) is None
+    assert _direct_kernel_fn(
+        dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 1, 1))), 1,
+        multichip=True,
+    ) is not None
     assert _direct_kernel_fn(dataclasses.replace(cfg, overlap=True), 1) is None
     assert _direct_kernel_fn(dataclasses.replace(cfg, backend="jnp"), 1) is None
 
