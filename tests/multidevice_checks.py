@@ -111,12 +111,34 @@ def check_faces_direct_step_distributed():
                         rtol=1e-6, atol=1e-6,
                         err_msg=f"mesh={mesh_shape} kind={kind} bc={bc}",
                     )
+        # bf16 storage: faces-direct == exchange path to bf16 rounding
+        cfg = SolverConfig(
+            grid=GridConfig(shape=grid),
+            stencil=StencilConfig(kind="7pt"),
+            mesh=MeshConfig(shape=(2, 2, 2)),
+            precision=Precision.bf16(),
+            backend="auto",
+        )
+        assert _direct_kernel_fn(cfg, 1, multichip=True) is not None
+        mesh = build_mesh(cfg.mesh)
+        u = jax.device_put(
+            jnp.asarray(u_host, jnp.bfloat16), field_sharding(mesh, cfg.mesh)
+        )
+        got = jax.jit(make_step_fn(cfg, mesh))(u)
+        import dataclasses as _dc
+
+        want_bf16 = jax.jit(make_step_fn(_dc.replace(cfg, backend="jnp"), mesh))(u)
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32),
+            np.asarray(want_bf16, dtype=np.float32),
+            rtol=2e-2, atol=1e-2,
+        )
     finally:
         if prior is None:
             os.environ.pop("HEAT3D_DIRECT_INTERPRET", None)
         else:
             os.environ["HEAT3D_DIRECT_INTERPRET"] = prior
-    print("faces_direct_step_distributed OK")
+    print("faces_direct_step_distributed OK (incl. bf16)")
 
 
 def check_overlap_step_distributed():
