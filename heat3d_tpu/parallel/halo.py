@@ -128,36 +128,43 @@ def exchange_halo_faces(
     mesh_cfg: MeshConfig,
     bc: BoundaryCondition,
     bc_value: float = 0.0,
+    width: int = 1,
 ):
-    """Faces-only ghost exchange: the six width-1 ghost faces of the
+    """Faces-only ghost exchange: the six width-``w`` ghost faces of the
     axis-ordered exchange WITHOUT materializing the padded volume (whose
     concatenate is a full read+write of the field — the dominant HBM cost
     of the exchange path; see ops/stencil_pallas_direct.py).
 
     Returns ``(xlo, xhi, ylo, yhi, zlo, zhi)`` with the progressive
-    extension the axis ordering implies: x faces are raw (1, ny, nz), y
-    faces x-extended (nx+2, 1, nz), z faces x+y-extended (nx+2, ny+2, 1) —
-    exactly the slices the padded array would have, corners included (the
-    later-axis send faces are built by concatenating the earlier ghosts
-    onto the boundary slice, which is how corner data propagates here).
-    Must run inside shard_map over the mesh in ``mesh_cfg``."""
+    extension the axis ordering implies: x faces are raw (w, ny, nz), y
+    faces x-extended (nx+2w, w, nz), z faces x+y-extended
+    (nx+2w, ny+2w, w) — exactly the slices the width-w padded array would
+    have, corners included (the later-axis send faces are built by
+    concatenating the earlier ghosts onto the boundary slab, which is how
+    corner data propagates here). Must run inside shard_map over the mesh
+    in ``mesh_cfg``."""
     periodic = bc is BoundaryCondition.PERIODIC
     names, sizes = mesh_cfg.axis_names, mesh_cfg.shape
+    w = width
+    if min(u.shape) < w:
+        raise ValueError(
+            f"halo width {w} exceeds a local extent of {u.shape}"
+        )
 
     xlo, xhi = axis_ghosts(
-        u[:1], u[-1:], names[0], sizes[0], periodic, bc_value
+        u[:w], u[-w:], names[0], sizes[0], periodic, bc_value
     )
     # y send faces carry the x ghosts (corner propagation)
-    y_lo_send = lax.concatenate([xlo[:, :1], u[:, :1], xhi[:, :1]], 0)
-    y_hi_send = lax.concatenate([xlo[:, -1:], u[:, -1:], xhi[:, -1:]], 0)
+    y_lo_send = lax.concatenate([xlo[:, :w], u[:, :w], xhi[:, :w]], 0)
+    y_hi_send = lax.concatenate([xlo[:, -w:], u[:, -w:], xhi[:, -w:]], 0)
     ylo, yhi = axis_ghosts(
         y_lo_send, y_hi_send, names[1], sizes[1], periodic, bc_value
     )
     # z send faces carry the x AND y ghosts
-    mid_lo = lax.concatenate([xlo[:, :, :1], u[:, :, :1], xhi[:, :, :1]], 0)
-    mid_hi = lax.concatenate([xlo[:, :, -1:], u[:, :, -1:], xhi[:, :, -1:]], 0)
-    z_lo_send = lax.concatenate([ylo[:, :, :1], mid_lo, yhi[:, :, :1]], 1)
-    z_hi_send = lax.concatenate([ylo[:, :, -1:], mid_hi, yhi[:, :, -1:]], 1)
+    mid_lo = lax.concatenate([xlo[:, :, :w], u[:, :, :w], xhi[:, :, :w]], 0)
+    mid_hi = lax.concatenate([xlo[:, :, -w:], u[:, :, -w:], xhi[:, :, -w:]], 0)
+    z_lo_send = lax.concatenate([ylo[:, :, :w], mid_lo, yhi[:, :, :w]], 1)
+    z_hi_send = lax.concatenate([ylo[:, :, -w:], mid_hi, yhi[:, :, -w:]], 1)
     zlo, zhi = axis_ghosts(
         z_lo_send, z_hi_send, names[2], sizes[2], periodic, bc_value
     )

@@ -32,6 +32,18 @@ from heat3d_tpu.parallel.halo import exchange_halo
 # Local compute on a ghost-padded block: (up, taps, compute_dtype, out_dtype) -> interior
 LocalCompute = Callable[..., jax.Array]
 
+_logged_paths: set = set()
+
+
+def _log_step_path_once(msg: str) -> None:
+    """INFO-log a step-path selection once per process (make_step_fn is
+    built several times per solver — step / residual / converge)."""
+    if msg not in _logged_paths:
+        _logged_paths.add(msg)
+        from heat3d_tpu.utils.logging import get_logger
+
+        get_logger(__name__).info("%s", msg)
+
 
 def _solver_taps(cfg: SolverConfig) -> np.ndarray:
     return stencil_taps(
@@ -80,6 +92,28 @@ def exchange(
     )
 
 
+def _pin_outside_domain(
+    arr: jax.Array, cfg: SolverConfig, local_indices
+) -> jax.Array:
+    """Pin cells of ``arr`` whose GLOBAL index lies outside the domain to
+    bc_value (Dirichlet; periodic has no out-of-domain cells — wrap ghosts
+    are genuine). ``local_indices[a]`` gives each dim's local indices
+    (local i maps to global device_start + i). Must run inside shard_map."""
+    if cfg.stencil.bc is BoundaryCondition.PERIODIC:
+        return arr
+    mask = None
+    for axis, (name, g, n) in enumerate(
+        zip(cfg.mesh.axis_names, cfg.grid.shape, cfg.local_shape)
+    ):
+        global_idx = lax.axis_index(name) * n + local_indices[axis]
+        m = jnp.logical_and(global_idx >= 0, global_idx < g)
+        shape = [1, 1, 1]
+        shape[axis] = arr.shape[axis]
+        m = m.reshape(shape)
+        mask = m if mask is None else jnp.logical_and(mask, m)
+    return jnp.where(mask, arr, jnp.asarray(cfg.stencil.bc_value, arr.dtype))
+
+
 def _fill_mid_ghosts(
     mid: jax.Array, cfg: SolverConfig, rings: int = 1
 ) -> jax.Array:
@@ -88,22 +122,12 @@ def _fill_mid_ghosts(
     cells — global domain ghosts (Dirichlet rings) and uneven-decomposition
     padding — back to bc_value, exactly as the unfused sequence sees them.
     ``mid`` carries ``rings`` ghost rings: local index i maps to global
-    index device_start + i - rings. Periodic needs no fill (wrap ghosts of
-    the intermediate are genuinely-updated wrapped cells). Must run inside
-    shard_map."""
-    if cfg.stencil.bc is BoundaryCondition.PERIODIC:
-        return mid
-    mask = None
-    for axis, (name, g, n) in enumerate(
-        zip(cfg.mesh.axis_names, cfg.grid.shape, cfg.local_shape)
-    ):
-        global_idx = lax.axis_index(name) * n + jnp.arange(-rings, n + rings)
-        m = jnp.logical_and(global_idx >= 0, global_idx < g)
-        shape = [1, 1, 1]
-        shape[axis] = n + 2 * rings
-        m = m.reshape(shape)
-        mask = m if mask is None else jnp.logical_and(mask, m)
-    return jnp.where(mask, mid, jnp.asarray(cfg.stencil.bc_value, mid.dtype))
+    index device_start + i - rings."""
+    return _pin_outside_domain(
+        mid,
+        cfg,
+        [jnp.arange(-rings, n + rings) for n in cfg.local_shape],
+    )
 
 
 def _local_stepk(
@@ -156,7 +180,8 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
     HBM writes) — halving (tb=1) or quartering (tb=2) traffic on the
     bandwidth-bound roofline. ``halo`` = updates fused per HBM sweep (1|2).
 
-    With ``multichip=True`` (the faces+shells step, halo=1 only) any mesh
+    With ``multichip=True`` (the faces+shells steps — _local_step_direct_faces
+    for halo=1, _local_superstep_direct_faces for halo=2) any mesh
     qualifies: the kernel computes the bulk and the exchanged faces patch
     the shard-boundary shells.
     """
@@ -193,56 +218,62 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
     return functools.partial(kernel, interpret=True) if interpret else kernel
 
 
-def _padded_slab(u: jax.Array, faces, axis: int, start: int) -> jax.Array:
-    """3-thick slice [start, start+3) along ``axis`` of the VIRTUAL
-    ghost-padded array (in padded coordinates), fully padded in the other
-    two axes — reassembled from the local block and the six
-    ``exchange_halo_faces`` faces, without the padded volume ever existing.
-    """
+def _padded_slab(
+    u: jax.Array, faces, axis: int, start: int, w: int = 1,
+    thickness: int = None,
+) -> jax.Array:
+    """``thickness``-thick slice [start, start+thickness) along ``axis`` of
+    the VIRTUAL width-``w`` ghost-padded array (in padded coordinates),
+    fully w-padded in the other two axes — reassembled from the local block
+    and the six ``exchange_halo_faces(width=w)`` faces, without the padded
+    volume ever existing. Default thickness 2w+1 (one output plane's
+    dependence)."""
+    thickness = thickness if thickness is not None else 2 * w + 1
     xlo, xhi, ylo, yhi, zlo, zhi = faces
     nx, ny, nz = u.shape
-    s = slice(start, start + 3)
+    s = slice(start, start + thickness)
+    rng = range(start, start + thickness)
     if axis == 0:
         parts = []
-        for p in range(start, start + 3):
-            if p == 0:
-                parts.append(xlo)
-            elif p == nx + 1:
-                parts.append(xhi)
+        for p in rng:
+            if p < w:
+                parts.append(xlo[p : p + 1])
+            elif p >= nx + w:
+                parts.append(xhi[p - nx - w : p - nx - w + 1])
             else:
-                parts.append(u[p - 1 : p])
-        core = lax.concatenate(parts, 0)  # (3, ny, nz)
+                parts.append(u[p - w : p - w + 1])
+        core = lax.concatenate(parts, 0)  # (thickness, ny, nz)
         core = lax.concatenate([ylo[s], core, yhi[s]], 1)
         return lax.concatenate([zlo[s], core, zhi[s]], 2)
     if axis == 1:
 
-        def xrow(p):  # x-extended row at padded y coord p: (nx+2, 1, nz)
-            if p == 0:
-                return ylo
-            if p == ny + 1:
-                return yhi
+        def xrow(p):  # x-extended row at padded y coord p: (nx+2w, 1, nz)
+            if p < w:
+                return ylo[:, p : p + 1]
+            if p >= ny + w:
+                return yhi[:, p - ny - w : p - ny - w + 1]
+            q = p - w
             return lax.concatenate(
-                [xlo[:, p - 1 : p], u[:, p - 1 : p], xhi[:, p - 1 : p]], 0
+                [xlo[:, q : q + 1], u[:, q : q + 1], xhi[:, q : q + 1]], 0
             )
 
-        core = lax.concatenate(
-            [xrow(p) for p in range(start, start + 3)], 1
-        )  # (nx+2, 3, nz)
+        core = lax.concatenate([xrow(p) for p in rng], 1)
         return lax.concatenate([zlo[:, s], core, zhi[:, s]], 2)
 
-    def xycol(p):  # x+y-extended column at padded z coord p: (nx+2, ny+2, 1)
-        if p == 0:
-            return zlo
-        if p == nz + 1:
-            return zhi
+    def xycol(p):  # x+y-extended column at padded z coord p: (nx+2w, ny+2w, 1)
+        if p < w:
+            return zlo[:, :, p : p + 1]
+        if p >= nz + w:
+            return zhi[:, :, p - nz - w : p - nz - w + 1]
+        q = p - w
         mid = lax.concatenate(
-            [xlo[:, :, p - 1 : p], u[:, :, p - 1 : p], xhi[:, :, p - 1 : p]], 0
+            [xlo[:, :, q : q + 1], u[:, :, q : q + 1], xhi[:, :, q : q + 1]], 0
         )
         return lax.concatenate(
-            [ylo[:, :, p - 1 : p], mid, yhi[:, :, p - 1 : p]], 1
+            [ylo[:, :, q : q + 1], mid, yhi[:, :, q : q + 1]], 1
         )
 
-    return lax.concatenate([xycol(p) for p in range(start, start + 3)], 2)
+    return lax.concatenate([xycol(p) for p in rng], 2)
 
 
 def _local_step_direct_faces(
@@ -292,6 +323,78 @@ def _local_step_direct_faces(
             )
             idx = [0, 0, 0]
             idx[axis] = pos
+            out = lax.dynamic_update_slice(out, shell, tuple(idx))
+    return out
+
+
+def _pin_slab_mid(
+    mid: jax.Array, cfg: SolverConfig, axis: int, start: int
+) -> jax.Array:
+    """Dirichlet ghost pinning for a slab-shaped superstep intermediate:
+    the slab analogue of _fill_mid_ghosts. ``mid`` carries one ghost ring;
+    along ``axis`` its plane q maps to local index start + q - 1 (``start``
+    in width-2 padded coordinates), on the other axes index r maps to local
+    r - 1."""
+    return _pin_outside_domain(
+        mid,
+        cfg,
+        [
+            start - 1 + jnp.arange(mid.shape[a])
+            if a == axis
+            else jnp.arange(mid.shape[a]) - 1
+            for a in range(3)
+        ],
+    )
+
+
+def _local_superstep_direct_faces(
+    u_local: jax.Array,
+    taps: np.ndarray,
+    cfg: SolverConfig,
+    direct2,
+) -> jax.Array:
+    """Multi-chip fused two-update superstep without the padded copy:
+    width-2 faces-only exchange + BC-fused direct2 bulk kernel + 2-deep
+    shard-boundary shell patches.
+
+    The direct2 kernel's local ghost synthesis is wrong only where a
+    two-step dependence (distance <= 2) reaches across a sharded axis — the
+    outermost TWO planes per side. Those are recomputed from 6-thick
+    virtual width-2 padded slabs (faces carry 2-deep neighbor data,
+    corners included): apply taps, pin the slab intermediate's domain
+    ghosts (storage-dtype round trip like the unfused sequence), apply taps
+    again, patch in. One exchange and one HBM sweep per TWO updates."""
+    from heat3d_tpu.parallel.halo import exchange_halo_faces
+
+    periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
+    compute_dtype = jnp.dtype(cfg.precision.compute)
+    out_dtype = jnp.dtype(cfg.precision.storage)
+    faces = exchange_halo_faces(
+        u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value, width=2
+    )
+    out = direct2(
+        u_local,
+        taps,
+        periodic=periodic,
+        bc_value=cfg.stencil.bc_value,
+        compute_dtype=compute_dtype,
+        out_dtype=out_dtype,
+    )
+    for axis, size in enumerate(cfg.mesh.shape):
+        if size == 1:
+            continue  # kernel's local BC/wrap is already exact on this axis
+        n = u_local.shape[axis]
+        for start in (0, n - 2):  # width-2 padded coords; final planes
+            slab = _padded_slab(u_local, faces, axis, start, w=2, thickness=6)
+            mid = apply_taps_padded(
+                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+            )
+            mid = _pin_slab_mid(mid, cfg, axis, start)
+            shell = apply_taps_padded(
+                mid, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+            )
+            idx = [0, 0, 0]
+            idx[axis] = start  # local planes [start, start+2)
             out = lax.dynamic_update_slice(out, shell, tuple(idx))
     return out
 
@@ -360,6 +463,14 @@ def make_step_fn(
     local_step = _local_step
     direct = _direct_kernel_fn(cfg, halo=1, multichip=True)
     if direct is not None:
+        _log_step_path_once(
+            "step path: %s direct kernel (no padded copy)"
+            % (
+                "single-shard"
+                if cfg.mesh.shape == (1, 1, 1)
+                else "faces-direct multi-chip"
+            )
+        )
         if cfg.mesh.shape == (1, 1, 1):
             periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
 
@@ -440,22 +551,38 @@ def make_superstep_fn(
     taps = _solver_taps(cfg)
     spec = P(*cfg.mesh.axis_names)
 
-    # (1,1,1)-mesh k=2: the BC-fused direct kernel does both updates in one
-    # sweep of the UNPADDED field — no width-2 ghost copy at all.
+    # k=2 with the BC-fused direct2 kernel: both updates in one sweep of the
+    # UNPADDED field — no width-2 ghost copy at all. On multi-chip meshes
+    # the faces-direct superstep patches the 2-deep shard-boundary shells.
     if cfg.time_blocking == 2:
-        direct2 = _direct_kernel_fn(cfg, halo=2)
+        direct2 = _direct_kernel_fn(cfg, halo=2, multichip=True)
         if direct2 is not None:
-            periodic2 = cfg.stencil.bc is BoundaryCondition.PERIODIC
-
-            def local2(u_local):
-                return direct2(
-                    u_local,
-                    taps,
-                    periodic=periodic2,
-                    bc_value=cfg.stencil.bc_value,
-                    compute_dtype=jnp.dtype(cfg.precision.compute),
-                    out_dtype=jnp.dtype(cfg.precision.storage),
+            if cfg.mesh.shape == (1, 1, 1):
+                _log_step_path_once(
+                    "superstep path: single-shard fused direct2 kernel"
                 )
+                periodic2 = cfg.stencil.bc is BoundaryCondition.PERIODIC
+
+                def local2(u_local):
+                    return direct2(
+                        u_local,
+                        taps,
+                        periodic=periodic2,
+                        bc_value=cfg.stencil.bc_value,
+                        compute_dtype=jnp.dtype(cfg.precision.compute),
+                        out_dtype=jnp.dtype(cfg.precision.storage),
+                    )
+
+            else:
+                _log_step_path_once(
+                    "superstep path: faces-direct fused direct2 kernel "
+                    "(multi-chip, no padded copy)"
+                )
+
+                def local2(u_local):
+                    return _local_superstep_direct_faces(
+                        u_local, taps, cfg, direct2
+                    )
 
             return jax.shard_map(
                 local2, mesh=mesh, in_specs=spec, out_specs=spec,

@@ -141,6 +141,58 @@ def check_faces_direct_step_distributed():
     print("faces_direct_step_distributed OK (incl. bf16)")
 
 
+def check_faces_direct_superstep_distributed():
+    """Multi-chip tb=2 faces-direct superstep (width-2 faces exchange +
+    fused direct2 bulk kernel + 2-deep shell patches, interpret-mode
+    kernel) == two plain exchange-path steps, across mesh shapes, stencils,
+    and BCs."""
+    import dataclasses
+    import os
+
+    from heat3d_tpu.parallel.step import _direct_kernel_fn, make_superstep_fn
+
+    prior = os.environ.get("HEAT3D_DIRECT_INTERPRET")
+    os.environ["HEAT3D_DIRECT_INTERPRET"] = "1"
+    try:
+        grid = (16, 16, 16)
+        u_host = golden.random_init(grid, seed=31)
+        for mesh_shape in [(8, 1, 1), (2, 2, 2), (1, 2, 4), (2, 4, 1)]:
+            for kind in ("7pt", "27pt"):
+                for bc, bcv in [
+                    (BoundaryCondition.DIRICHLET, 1.5),
+                    (BoundaryCondition.PERIODIC, 0.0),
+                ]:
+                    cfg = SolverConfig(
+                        grid=GridConfig(shape=grid),
+                        stencil=StencilConfig(kind=kind, bc=bc, bc_value=bcv),
+                        mesh=MeshConfig(shape=mesh_shape),
+                        backend="auto",
+                        time_blocking=2,
+                    )
+                    assert _direct_kernel_fn(cfg, 2, multichip=True) is not None
+                    mesh = build_mesh(cfg.mesh)
+                    u = jax.device_put(
+                        jnp.asarray(u_host), field_sharding(mesh, cfg.mesh)
+                    )
+                    got = jax.jit(make_superstep_fn(cfg, mesh))(u)
+                    cfg1 = dataclasses.replace(
+                        cfg, time_blocking=1, backend="jnp"
+                    )
+                    s1 = jax.jit(make_step_fn(cfg1, mesh))
+                    want = s1(s1(u))
+                    np.testing.assert_allclose(
+                        np.asarray(got), np.asarray(want),
+                        rtol=1e-6, atol=1e-6,
+                        err_msg=f"mesh={mesh_shape} kind={kind} bc={bc}",
+                    )
+    finally:
+        if prior is None:
+            os.environ.pop("HEAT3D_DIRECT_INTERPRET", None)
+        else:
+            os.environ["HEAT3D_DIRECT_INTERPRET"] = prior
+    print("faces_direct_superstep_distributed OK")
+
+
 def check_overlap_step_distributed():
     """Overlap (interior/boundary split) step == unsplit step on real
     multi-device meshes — the correctness half of SURVEY.md §7.3 item 2."""
@@ -439,6 +491,7 @@ def main():
     assert n == 8, f"expected 8 CPU devices, got {n} ({jax.devices()})"
     check_step_matches_single_device()
     check_faces_direct_step_distributed()
+    check_faces_direct_superstep_distributed()
     check_overlap_step_distributed()
     check_uneven_decomposition()
     check_time_blocking_distributed()
