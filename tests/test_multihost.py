@@ -143,13 +143,31 @@ def test_two_process_cli_golden_and_checkpoint(tmp_path):
     assert manifest2["step"] == 8
 
 
-@pytest.mark.parametrize("extra", [[], ["--time-blocking", "2"]])
-def test_two_process_matches_single_process(extra, tmp_path):
+@pytest.mark.parametrize(
+    "extra",
+    [
+        [],
+        ["--time-blocking", "2"],
+        # faces-direct paths (interpret-mode kernels) across real process
+        # boundaries: step and fused tb=2 superstep
+        pytest.param([], id="faces-direct", marks=[]),
+        pytest.param(["--time-blocking", "2"], id="faces-direct-tb2", marks=[]),
+    ],
+)
+def test_two_process_matches_single_process(extra, request, tmp_path):
     """Same run, 1 process vs 2 rendezvoused processes: identical residual
     (the '-np 1 vs -np P' oracle across real process boundaries)."""
-    outs = _run_pair(
-        ["--grid", "16", "--steps", "4", "--mesh", "2", "2", "2", *extra]
-    )
+    direct = "faces-direct" in request.node.callspec.id
+    if direct:
+        os.environ["HEAT3D_DIRECT_INTERPRET"] = "1"
+    try:
+        outs = _run_pair(
+            ["--grid", "16", "--steps", "4", "--mesh", "2", "2", "2",
+             "--backend", "auto", *extra]
+        )
+    finally:
+        if direct:
+            os.environ.pop("HEAT3D_DIRECT_INTERPRET", None)
     two = _summary(outs[0][1])
 
     env = _cpu_env(8)
