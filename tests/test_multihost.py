@@ -144,33 +144,31 @@ def test_two_process_cli_golden_and_checkpoint(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "extra",
+    ("extra", "direct"),
     [
-        [],
-        ["--time-blocking", "2"],
+        pytest.param([], False, id="exchange"),
+        pytest.param(["--time-blocking", "2"], False, id="exchange-tb2"),
         # faces-direct paths (interpret-mode kernels) across real process
         # boundaries: step and fused tb=2 superstep
-        pytest.param([], id="faces-direct", marks=[]),
-        pytest.param(["--time-blocking", "2"], id="faces-direct-tb2", marks=[]),
+        pytest.param([], True, id="faces-direct"),
+        pytest.param(["--time-blocking", "2"], True, id="faces-direct-tb2"),
     ],
 )
-def test_two_process_matches_single_process(extra, request, tmp_path):
+def test_two_process_matches_single_process(extra, direct, monkeypatch, tmp_path):
     """Same run, 1 process vs 2 rendezvoused processes: identical residual
     (the '-np 1 vs -np P' oracle across real process boundaries)."""
-    direct = "faces-direct" in request.node.callspec.id
     if direct:
-        os.environ["HEAT3D_DIRECT_INTERPRET"] = "1"
-    try:
-        outs = _run_pair(
-            ["--grid", "16", "--steps", "4", "--mesh", "2", "2", "2",
-             "--backend", "auto", *extra]
-        )
-    finally:
-        if direct:
-            os.environ.pop("HEAT3D_DIRECT_INTERPRET", None)
+        monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    else:
+        # pin the exchange path even if the var is set ambiently
+        monkeypatch.delenv("HEAT3D_DIRECT_INTERPRET", raising=False)
+    outs = _run_pair(
+        ["--grid", "16", "--steps", "4", "--mesh", "2", "2", "2", *extra]
+    )
     two = _summary(outs[0][1])
 
     env = _cpu_env(8)
+    env.pop("HEAT3D_DIRECT_INTERPRET", None)  # baseline = exchange path
     single = subprocess.run(
         [sys.executable, "-m", "heat3d_tpu", "--grid", "16", "--steps", "4",
          "--mesh", "2", "2", "2", *extra],
