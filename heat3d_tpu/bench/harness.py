@@ -75,6 +75,8 @@ def bench_throughput(
         "dtype": cfg.precision.storage,
         "backend": cfg.backend,
         "time_blocking": cfg.time_blocking,
+        "overlap": cfg.overlap,
+        "halo": cfg.halo,
         "steps": steps,
         "seconds_best": best,
         "seconds_all": times,

@@ -108,13 +108,14 @@ def render(results: List[Dict]) -> str:
         lines += [
             "### Throughput (measured)",
             "",
-            "| Grid | Stencil | Mesh | Dtype | Backend | Steps | Gcell/s | Gcell/s/chip | RTT-dominated |",
-            "|---|---|---|---|---|---|---|---|---|",
+            "| Grid | Stencil | Mesh | Dtype | Backend | tb | Steps | Gcell/s | Gcell/s/chip | RTT-dominated |",
+            "|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in thr:
             lines.append(
                 f"| {_fmt_grid(r['grid'])} | {r['stencil']} | "
                 f"{_fmt_mesh(r['mesh'])} | {r['dtype']} | {r['backend']} | "
+                f"{r.get('time_blocking', 1)} | "
                 f"{r['steps']} | {r['gcell_per_sec']:.2f} | "
                 f"{r['gcell_per_sec_per_chip']:.2f} | "
                 f"{'yes' if r.get('rtt_dominated') else 'no'} |"
