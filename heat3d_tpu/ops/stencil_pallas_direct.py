@@ -26,7 +26,14 @@ is wrong, is recomputed from the exchanged ghost faces and patched in.
 Layout: the local (nx, ny, nz) volume is walked as a 2D Pallas grid
 (J, nx + 2k) — y-chunk-column outer (J = ny/by picked to fit VMEM), x-plane
 inner — so arbitrarily large fields stream through a 3-slot VMEM plane ring
-exactly once per update. Reference parity (SURVEY.md §2 C1): this is the
+exactly once per update.
+
+Note on grid-step count (so it isn't re-derived): per-step fixed overhead
+cannot be amortized by fusing bi > 1 x-planes per block. Every scheme holds
+~10 block-sized buffers (rings + in/out pipelines), so steps =
+(ny/by)(nx/bi) ≈ cells x 10 x itemsize / VMEM_budget independent of the
+bi/by split — ~4k steps at 1024^3 fp32 is structural; only raising the
+VMEM budget (capped by Mosaic headroom) lowers it. Reference parity (SURVEY.md §2 C1): this is the
 CUDA Jacobi kernel's job done the TPU way — the grid pipeline is the
 ``__global__`` launch, the plane ring is the shared-memory tile, and the
 ghost synthesis replaces the separate boundary kernels.
