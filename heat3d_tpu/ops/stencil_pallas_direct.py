@@ -97,10 +97,11 @@ def choose_chunk(
     for by in range(ny, 0, -1):
         if ny % by:
             continue
-        if ny >= 8 and by % 8:
+        if by % 8 and by != ny:
+            # multi-chunk ghost-row loads need 8-row-aligned blocks
+            # (_row_block_specs); only the full-extent single chunk may be
+            # unaligned
             continue
-        if halo == 2 and by % 2:
-            continue  # (1,2,nz) ghost-row blocks need even element offsets
         if _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize) <= _VMEM_BUDGET:
             return by
     return None
@@ -115,8 +116,6 @@ def direct_supported(
     nx, ny, nz = local_shape
     if halo == 2 and (nx < 2 or ny < 2 or nz < 2):
         return False  # wrapped/clamped width-2 ghosts would alias interior
-    if halo == 2 and ny % 2:
-        return False  # 2-row ghost blocks need even wrapped offsets
     return (
         choose_chunk(local_shape, halo, in_itemsize, out_itemsize) is not None
     )
@@ -147,6 +146,46 @@ def _assemble_plane(chunk, top, bot, bc, periodic, sub_top, sub_bot):
 from heat3d_tpu.ops.stencil_pallas import _plane_taps  # noqa: E402
 
 
+def _row_block_specs(x_of, by, ny, nz, periodic):
+    """BlockSpecs for the ghost-row loads of a multi-chunk kernel: 8-row
+    blocks (sublane-aligned, see _chunk_ghost_rows) addressed in units of
+    ny/8. Valid only when by % 8 == 0 (choose_chunk guarantees it whenever
+    ny >= 8, and ny < 8 forces the single-chunk mode that skips these)."""
+    nyb = ny // 8
+    if periodic:
+        tb_of = lambda j: jax.lax.rem(by * j // 8 - 1 + nyb, nyb)
+        bb_of = lambda j: jax.lax.rem((by * j + by) // 8, nyb)
+    else:
+        # domain-edge chunk columns load an in-range dummy block; the
+        # kernel substitutes the boundary value there
+        tb_of = lambda j: jnp.maximum(by * j // 8 - 1, 0)
+        bb_of = lambda j: jnp.minimum((by * j + by) // 8, nyb - 1)
+
+    def make(idx_of):
+        return pl.BlockSpec(
+            (1, 8, nz), lambda j, i, f=idx_of: (x_of(i), f(j), 0)
+        )
+
+    return [make(tb_of), make(bb_of)]
+
+
+def _chunk_ghost_rows(chunk, top_ref, bot_ref, h, periodic, bc):
+    """Extract the (h, nz) ghost-row values above/below the current chunk.
+
+    Multi-chunk mode loads 8-row-aligned blocks (TPU lowering requires
+    sublane block dims divisible by 8 or full-extent): since by % 8 == 0,
+    the top ghost rows are always the LAST h rows of the block above and
+    the bottom ghost rows the FIRST h of the block below — static in-block
+    offsets. Single-chunk mode (no row refs) derives them from the chunk
+    itself: periodic wrap rows, or the boundary value."""
+    if top_ref is None:  # single chunk column
+        if periodic:
+            return chunk[-h:], chunk[:h]
+        fill = jnp.full((h, chunk.shape[1]), bc, chunk.dtype)
+        return fill, fill
+    return top_ref[0, 8 - h :], bot_ref[0, :h]
+
+
 def _direct_kernel(
     u_ref,
     top_ref,
@@ -173,8 +212,7 @@ def _direct_kernel(
     bc = u_ref.dtype.type(bc_value)
 
     chunk = u_ref[0]  # (by, nz) aligned
-    top = top_ref[0]  # (1, nz)
-    bot = bot_ref[0]
+    top, bot = _chunk_ghost_rows(chunk, top_ref, bot_ref, 1, periodic, bc)
     plane = _assemble_plane(
         chunk,
         top,
@@ -211,6 +249,11 @@ def _direct_kernel(
             ).astype(out_dtype)
 
 
+def _direct_kernel_single(u_ref, out_ref, ring, **params):
+    """Single-chunk-column variant: no ghost-row refs (derived in-kernel)."""
+    _direct_kernel(u_ref, None, None, out_ref, ring, **params)
+
+
 def apply_taps_direct(
     u: jax.Array,
     taps: np.ndarray,
@@ -237,15 +280,12 @@ def apply_taps_direct(
 
     if periodic:
         x_of = lambda i: jax.lax.rem(i - 1 + nx, nx)
-        top_of = lambda j: jax.lax.rem(by * j - 1 + ny, ny)
-        bot_of = lambda j: jax.lax.rem(by * j + by, ny)
     else:
         x_of = lambda i: jnp.clip(i - 1, 0, nx - 1)
-        top_of = lambda j: jnp.maximum(by * j - 1, 0)
-        bot_of = lambda j: jnp.minimum(by * j + by, ny - 1)
 
+    single = n_chunks == 1
     kernel = functools.partial(
-        _direct_kernel,
+        _direct_kernel if not single else _direct_kernel_single,
         taps_flat=flat,
         nx=nx,
         by=by,
@@ -256,16 +296,16 @@ def apply_taps_direct(
         compute_dtype=compute_dtype,
         out_dtype=jnp.dtype(out_dtype),
     )
+    in_specs = [pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0))]
+    operands = (u,)
+    if not single:
+        in_specs += _row_block_specs(x_of, by, ny, nz, periodic)
+        operands = (u, u, u)
     flops_per_cell = 2 * len(flat)
     return pl.pallas_call(
         kernel,
         grid=(n_chunks, nx + 2),
-        in_specs=[
-            pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0)),
-            # single ghost rows above/below the chunk (block = 1 row)
-            pl.BlockSpec((1, 1, nz), lambda j, i: (x_of(i), top_of(j), 0)),
-            pl.BlockSpec((1, 1, nz), lambda j, i: (x_of(i), bot_of(j), 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, by, nz), lambda j, i: (jnp.maximum(i - 2, 0), j, 0)
         ),
@@ -278,7 +318,7 @@ def apply_taps_direct(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(u, u, u)
+    )(*operands)
 
 
 def _direct2_kernel(
@@ -315,8 +355,7 @@ def _direct2_kernel(
     bc_c = compute_dtype(bc_value)
 
     chunk = u_ref[0]  # (by, nz)
-    top = top_ref[0]  # (2, nz)
-    bot = bot_ref[0]
+    top, bot = _chunk_ghost_rows(chunk, top_ref, bot_ref, 2, periodic, bc_s)
     plane = _assemble_plane(
         chunk,
         top,
@@ -384,6 +423,11 @@ def _direct2_kernel(
             ).astype(out_dtype)
 
 
+def _direct2_kernel_single(u_ref, out_ref, ring_a, ring_b, **params):
+    """Single-chunk-column variant: no ghost-row refs (derived in-kernel)."""
+    _direct2_kernel(u_ref, None, None, out_ref, ring_a, ring_b, **params)
+
+
 def apply_taps_direct2(
     u: jax.Array,
     taps: np.ndarray,
@@ -398,10 +442,6 @@ def apply_taps_direct2(
     The single-chip analogue of the width-2-exchange + stream2 superstep,
     minus the padded-copy materialization."""
     nx, ny, nz = u.shape
-    if ny % 2:
-        raise ValueError(
-            f"apply_taps_direct2 needs even ny (2-row ghost blocks), got {ny}"
-        )
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
     by = choose_chunk(
@@ -414,15 +454,12 @@ def apply_taps_direct2(
 
     if periodic:
         x_of = lambda i: jax.lax.rem(i - 2 + 2 * nx, nx)
-        top_of = lambda j: jax.lax.rem(by * j - 2 + ny, ny) // 2
-        bot_of = lambda j: jax.lax.rem(by * j + by, ny) // 2
     else:
         x_of = lambda i: jnp.clip(i - 2, 0, nx - 1)
-        top_of = lambda j: jnp.maximum(by * j - 2, 0) // 2
-        bot_of = lambda j: jnp.minimum(by * j + by, ny - 2) // 2
 
+    single = n_chunks == 1
     kernel = functools.partial(
-        _direct2_kernel,
+        _direct2_kernel if not single else _direct2_kernel_single,
         taps_flat=flat,
         nx=nx,
         by=by,
@@ -434,17 +471,16 @@ def apply_taps_direct2(
         storage_dtype=u.dtype,
         out_dtype=jnp.dtype(out_dtype),
     )
+    in_specs = [pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0))]
+    operands = (u,)
+    if not single:
+        in_specs += _row_block_specs(x_of, by, ny, nz, periodic)
+        operands = (u, u, u)
     flops_per_cell = 2 * 2 * len(flat)
     return pl.pallas_call(
         kernel,
         grid=(n_chunks, nx + 4),
-        in_specs=[
-            pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0)),
-            # width-2 ghost-row blocks; 2-row blocks need even element
-            # offsets, guaranteed by choose_chunk's even-by rule for halo=2
-            pl.BlockSpec((1, 2, nz), lambda j, i: (x_of(i), top_of(j), 0)),
-            pl.BlockSpec((1, 2, nz), lambda j, i: (x_of(i), bot_of(j), 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, by, nz), lambda j, i: (jnp.maximum(i - 4, 0), j, 0)
         ),
@@ -460,4 +496,4 @@ def apply_taps_direct2(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(u, u, u)
+    )(*operands)

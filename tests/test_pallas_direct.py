@@ -108,17 +108,15 @@ def test_chunking_feasibility():
             for halo in (1, 2):
                 by = choose_chunk((edge,) * 3, halo, itemsize, itemsize)
                 assert by is not None and edge % by == 0, (edge, itemsize, halo)
-    # no 8-multiple divisor of ny -> unsupported (falls back to exchange path)
-    assert not direct_supported((16, 12, 16), 1)
+    # ny with no 8-multiple divisor runs single-chunk (full-extent blocks
+    # are exempt from the sublane alignment rule)
+    assert direct_supported((16, 12, 16), 1)
+    # ...but multi-chunk never picks an unaligned by
+    assert choose_chunk((16, 48, 16), 1) in (48, 40, 24, 16, 8)
     # width-2 ghosts would alias on sub-2 extents
     assert not direct_supported((1, 8, 8), 2)
-    # odd ny: 2-row ghost blocks can't address odd wrapped offsets
-    assert not direct_supported((6, 5, 8), 2)
-    with pytest.raises(ValueError, match="even ny"):
-        apply_taps_direct2(
-            jnp.zeros((6, 5, 8)), _taps("7pt", (6, 5, 8)), periodic=True,
-            interpret=True,
-        )
+    # odd ny < 8 runs in single-chunk mode (no sublane-aligned row blocks)
+    assert direct_supported((6, 5, 8), 2)
 
 
 def test_dispatch_used_on_111_mesh(monkeypatch):
@@ -211,3 +209,54 @@ def test_direct2_compiled_on_tpu():
             np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
             err_msg=f"{bc}",
         )
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_multichunk_interpret_matches_jnp(kind, monkeypatch):
+    """Force n_chunks > 1 (ny=16, by=8) so the 8-row-aligned ghost-row
+    blocks — the TPU-lowerable replacement for single-row BlockSpecs — are
+    exercised numerically, top/bottom substitution and wrap included."""
+    import heat3d_tpu.ops.stencil_pallas_direct as d
+
+    monkeypatch.setattr(d, "choose_chunk", lambda *a, **k: 8)
+    shape = (6, 16, 32)
+    u = jnp.asarray(golden.random_init(shape, seed=9))
+    taps = _taps(kind, shape)
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        want = step_single_device(u, taps, bc, bcv)
+        got = d.apply_taps_direct(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+            err_msg=f"{kind} {bc} {bcv} (direct)",
+        )
+        want2 = step_single_device(want, taps, bc, bcv)
+        got2 = d.apply_taps_direct2(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got2), np.asarray(want2), rtol=1e-6, atol=1e-6,
+            err_msg=f"{kind} {bc} {bcv} (direct2)",
+        )
+
+
+def test_direct_kernels_cross_lower_for_tpu(monkeypatch):
+    """Pallas->Mosaic lowering for the TPU target runs host-side, so the
+    block-spec alignment rules are checkable without hardware (this caught
+    the original single-row ghost BlockSpecs, which violated the
+    8-divisible-sublane rule). Covers single- and multi-chunk modes."""
+    import heat3d_tpu.ops.stencil_pallas_direct as d
+
+    shape = (16, 32, 128)
+    taps = _taps("27pt", shape)
+    u = jax.ShapeDtypeStruct(shape, jnp.float32)
+    for by in (32, 8):  # single-chunk, then 4-chunk
+        monkeypatch.setattr(d, "choose_chunk", lambda *a, _by=by, **k: _by)
+        for periodic in (False, True):
+            for fn in (d.apply_taps_direct, d.apply_taps_direct2):
+                low = jax.jit(
+                    lambda v, f=fn, p=periodic: f(v, taps, periodic=p, bc_value=0.5)
+                ).trace(u).lower(lowering_platforms=("tpu",))
+                assert "tpu_custom_call" in low.as_text(), (by, periodic, fn)
