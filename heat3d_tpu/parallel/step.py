@@ -197,9 +197,12 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
         return None
     if cfg.is_padded:
         return None
-    # HEAT3D_DIRECT_INTERPRET exercises this dispatch path off-TPU (tests)
+    # HEAT3D_DIRECT_INTERPRET exercises this dispatch path off-TPU (tests);
+    # HEAT3D_DIRECT_FORCE selects the real (Mosaic) kernels off-TPU for
+    # compile-only cross-lowering tests
     interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
-    if not interpret and jax.devices()[0].platform != "tpu":
+    forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))
+    if not interpret and not forced and jax.devices()[0].platform != "tpu":
         return None
     try:
         from heat3d_tpu.ops.stencil_pallas_direct import (

@@ -314,6 +314,42 @@ def test_dma_halo_superstep_lowers_for_multichip_tpu(width):
     assert "tpu_custom_call" in txt  # the Mosaic DMA kernels
 
 
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_faces_direct_step_lowers_for_multichip_tpu(kind, monkeypatch):
+    """The multi-chip faces-direct step and tb=2 superstep — Mosaic direct
+    kernels + faces-only ppermute exchange + shell patches — lower for a
+    (2,2,2) TPU mesh (HEAT3D_DIRECT_FORCE selects the real kernels
+    off-hardware; pallas->Mosaic lowering runs host-side, so block-spec
+    violations surface here, not on the chip)."""
+    monkeypatch.setenv("HEAT3D_DIRECT_FORCE", "1")
+    from heat3d_tpu.parallel.step import _direct_kernel_fn
+
+    for bc in (BoundaryCondition.DIRICHLET, BoundaryCondition.PERIODIC):
+        cfg = SolverConfig(
+            grid=GridConfig.cube(32),
+            stencil=StencilConfig(kind=kind, bc=bc, bc_value=0.5),
+            mesh=MeshConfig(shape=(2, 2, 2)),
+            backend="auto",
+        )
+        assert _direct_kernel_fn(cfg, 1, multichip=True) is not None
+        am = abstract_mesh(cfg.mesh)
+        step = make_step_fn(cfg, am, with_residual=True)
+        txt = lower_for_mesh(
+            step, cfg.mesh, (cfg.grid.shape, jnp.float32, P("x", "y", "z"))
+        ).as_text()
+        assert "tpu_custom_call" in txt  # Mosaic direct kernel
+        assert "collective_permute" in txt  # faces exchange
+        cfg2 = SolverConfig(
+            grid=GridConfig.cube(32), stencil=cfg.stencil, mesh=cfg.mesh,
+            backend="auto", time_blocking=2,
+        )
+        sstep = make_superstep_fn(cfg2, am)
+        txt2 = lower_for_mesh(
+            sstep, cfg2.mesh, (cfg2.grid.shape, jnp.float32, P("x", "y", "z"))
+        ).as_text()
+        assert "tpu_custom_call" in txt2 and "collective_permute" in txt2
+
+
 def test_unknown_halo_transport_rejected():
     with pytest.raises(ValueError, match="halo transport"):
         SolverConfig(grid=GridConfig.cube(8), halo="nccl")
