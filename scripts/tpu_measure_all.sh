@@ -32,6 +32,15 @@ timeout 3600 bash scripts/run_bench_suite.sh bench_results.jsonl 2>&1 \
 echo "--- stage 3: headline bench" | tee -a "$LOG"
 timeout 1200 python bench.py 2>&1 | tee -a "$LOG"
 
+echo "--- stage 3b: direct-vs-exchange A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
+for mode in direct exchange; do
+  env_prefix=()
+  [[ $mode == exchange ]] && env_prefix=(env HEAT3D_NO_DIRECT=1)
+  out=$("${env_prefix[@]}" timeout 1200 python -m heat3d_tpu.bench \
+    --grid 512 --steps 50 --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
+  echo "$mode: $out" | tee -a "$LOG"
+done
+
 echo "--- stage 4: profile traces" | tee -a "$LOG"
 for tb in 1 2; do
   GRID=512 STEPS=20 TB=$tb timeout 1200 \
