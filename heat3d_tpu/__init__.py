@@ -5,7 +5,9 @@ A ground-up re-design of the capability set of the reference repo
 CUDA-aware MPI halo exchange + MPI_Cart_create 3D decomposition) as an
 idiomatic JAX/XLA/Pallas program:
 
-- the CUDA 7-point Jacobi stencil kernel        -> Pallas TPU kernel (``ops.stencil_pallas``)
+- the CUDA 7-point Jacobi stencil kernel        -> Pallas TPU kernels: BC-fused direct
+  streaming kernels reading the unpadded field (``ops.stencil_pallas_direct``,
+  single- and fused two-update forms) plus exchange-padded kernels (``ops.stencil_pallas``)
 - CUDA-aware MPI_Isend/Irecv ghost-cell exchange -> ``shard_map`` + ``lax.ppermute``
   over ICI (``parallel.halo``), with a Pallas ``make_async_remote_copy`` tier
 - MPI_Cart_create 3D Cartesian decomposition     -> ``jax.sharding.Mesh`` mapped onto
@@ -31,7 +33,7 @@ from heat3d_tpu.core.config import (
 from heat3d_tpu.core.stencils import STENCILS, Stencil, stencil_taps
 from heat3d_tpu.models.heat3d import HeatSolver3D
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "BoundaryCondition",
