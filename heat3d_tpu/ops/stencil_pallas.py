@@ -100,7 +100,9 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
     if (
         cfg.mesh.shape == (1, 1, 1)
         and not cfg.is_padded
-        and not cfg.overlap
+        # overlap=True rides the direct kernel for tb=1 (the tb=2 superstep
+        # keeps its overlap mutual exclusion, checked below)
+        and not (cfg.overlap and cfg.time_blocking != 1)
         and cfg.halo == "ppermute"
         and not os.environ.get("HEAT3D_NO_DIRECT")
     ):

@@ -191,7 +191,12 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
         return None
     if not multichip and cfg.mesh.shape != (1, 1, 1):
         return None
-    if cfg.overlap or cfg.halo != "ppermute":
+    if cfg.halo != "ppermute":
+        return None
+    # overlap=True is satisfied BY the faces-direct step (the kernel has no
+    # data dependence on the face ppermutes, so XLA runs them concurrently);
+    # only the tb=2 superstep keeps its overlap mutual exclusion
+    if cfg.overlap and halo != 1:
         return None
     if cfg.backend not in ("pallas", "auto"):
         return None
@@ -492,7 +497,10 @@ def make_step_fn(
             def local_step(u_local, taps, cfg, compute_padded):
                 return _local_step_direct_faces(u_local, taps, cfg, direct)
 
-    if cfg.overlap:
+    if cfg.overlap and direct is None:
+        # jnp interior/boundary split — the portable overlap form; when the
+        # direct kernel dispatched above, the faces-direct step already
+        # overlaps the face ppermutes with the bulk sweep
         if min(cfg.local_shape) < 3:
             raise ValueError(
                 f"overlap=True needs local blocks >= 3 per axis to have an "

@@ -188,10 +188,14 @@ def test_compiled_bf16_on_tpu():
 
 
 @pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
-def test_overlap_with_pallas_backend_on_tpu():
+def test_overlap_with_pallas_backend_on_tpu(monkeypatch):
     """overlap=True feeds the Pallas kernel an odd-extent (n-2)^3 interior —
-    must compile (full-extent y window, literal-0 offset) and match."""
+    must compile (full-extent y window, literal-0 offset) and match.
+    HEAT3D_NO_DIRECT pins the windowed interior/boundary split: by default
+    overlap now rides the direct kernel, which would bypass this path."""
     import dataclasses
+
+    monkeypatch.setenv("HEAT3D_NO_DIRECT", "1")
 
     from heat3d_tpu.core import golden
     from heat3d_tpu.models.heat3d import HeatSolver3D

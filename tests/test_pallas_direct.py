@@ -136,8 +136,8 @@ def test_dispatch_used_on_111_mesh(monkeypatch):
     assert _direct_kernel_fn(cfg, 1) is None
     monkeypatch.delenv("HEAT3D_NO_DIRECT")
     # plain dispatch never fires off a (1,1,1) mesh (multi-chip goes through
-    # the faces-direct step, which passes multichip=True), nor under
-    # overlap/jnp backend
+    # the faces-direct step, which passes multichip=True), nor for the jnp
+    # backend
     assert _direct_kernel_fn(
         dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 1, 1))), 1
     ) is None
@@ -145,7 +145,10 @@ def test_dispatch_used_on_111_mesh(monkeypatch):
         dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 1, 1))), 1,
         multichip=True,
     ) is not None
-    assert _direct_kernel_fn(dataclasses.replace(cfg, overlap=True), 1) is None
+    # overlap=True is satisfied by the (faces-)direct step for halo=1; the
+    # tb=2 superstep keeps its overlap mutual exclusion
+    assert _direct_kernel_fn(dataclasses.replace(cfg, overlap=True), 1) is not None
+    assert _direct_kernel_fn(dataclasses.replace(cfg, overlap=True), 2) is None
     assert _direct_kernel_fn(dataclasses.replace(cfg, backend="jnp"), 1) is None
 
 
