@@ -425,9 +425,11 @@ def check_dma_halo_ring_interpret():
 
     jax 0.9's interpret mode cannot discharge remote DMA on meshes with >1
     named axis (dma_start_p NotImplementedError, MESH and LOGICAL device-id
-    forms alike — verified), so multi-axis composition executes only on real
-    multi-chip hardware; here each array axis is driven on a 1D mesh and the
-    3D composition is covered by the TPU lowering tests
+    forms alike — verified; the check binds to the shard_map MESH, so even
+    an (8,1,1) 3-named-axis mesh is rejected, which is why no full-step
+    DMA execution check exists off-TPU), so multi-axis composition executes
+    only on real multi-chip hardware; here each array axis is driven on a
+    1D mesh and the 3D composition is covered by the TPU lowering tests
     (tests/test_distributed.py)."""
     from jax.sharding import Mesh, NamedSharding
 
