@@ -1,0 +1,80 @@
+"""Compare measured throughput rows against the HBM traffic-model ceilings.
+
+Reads a bench_results.jsonl (bench.harness rows) and prints, per throughput
+row, the step path it ran, its bytes/cell/update, the bandwidth ceiling at
+the given HBM rate, and the achieved fraction — the "where did the rest
+go" accounting BASELINE.md's traffic model sets up.
+
+Usage: python scripts/roofline_check.py bench_results.jsonl [--hbm-gbps 819]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def bytes_per_cell_update(row) -> tuple[float, str]:
+    """Traffic model per path (BASELINE.md 'HBM traffic model')."""
+    item = 2 if row["dtype"] == "bfloat16" else 4
+    tb = row.get("time_blocking", 1)
+    mesh = row.get("mesh", [1, 1, 1])
+    single = all(m == 1 for m in mesh)
+    halo = row.get("halo", "ppermute")
+    overlap = row.get("overlap", False)
+    # the direct kernels apply on unpadded shards for ppermute transport;
+    # DMA transport and tb>2 keep the padded exchange (one extra volume
+    # read+write per exchange)
+    direct = halo == "ppermute" and tb in (1, 2)
+    if direct and not (overlap and tb == 2):
+        per_update = 2 * item / tb  # one read + one write per sweep of tb
+        path = f"direct{'' if tb == 1 else '2'}{'' if single else '+faces'}"
+    else:
+        # exchange path: padded copy (r+w) once per exchange + sweep per
+        # update (tb updates share one exchange)
+        per_update = 2 * item + 2 * item / tb
+        path = f"exchange(tb={tb})"
+    return per_update, path
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("results")
+    ap.add_argument("--hbm-gbps", type=float, default=819.0,
+                    help="chip HBM bandwidth (GB/s); v5e ~819, v5p ~2765")
+    args = ap.parse_args()
+
+    rows = []
+    with open(args.results) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(r, dict) and r.get("bench") == "throughput":
+                rows.append(r)
+    if not rows:
+        print("no throughput rows found", file=sys.stderr)
+        return 1
+
+    print(f"{'grid':>6} {'dtype':>8} {'tb':>2} {'path':>16} "
+          f"{'B/cell/upd':>10} {'ceiling':>9} {'measured':>9} {'achieved':>8}")
+    for r in rows:
+        per_update, path = bytes_per_cell_update(r)
+        ceiling = args.hbm_gbps / per_update  # Gcell/s/chip
+        meas = r["gcell_per_sec_per_chip"]
+        grid = r["grid"][0] if len(set(r["grid"])) == 1 else "x".join(
+            map(str, r["grid"]))
+        flag = " (RTT!)" if r.get("rtt_dominated") else ""
+        print(f"{grid:>6} {r['dtype']:>8} {r.get('time_blocking', 1):>2} "
+              f"{path:>16} {per_update:>10.1f} {ceiling:>9.1f} "
+              f"{meas:>9.2f} {meas / ceiling:>7.1%}{flag}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
