@@ -121,24 +121,47 @@ def direct_supported(
     )
 
 
-def _assemble_plane(chunk, top, bot, bc, periodic, sub_top, sub_bot):
-    """Build the ghost-framed plane (by+2h, nz+2h) from an aligned (by, nz)
-    chunk plus (h, nz) ghost-row blocks; h = halo width. ``sub_top`` /
-    ``sub_bot`` force the row blocks to the Dirichlet boundary value (domain-
-    edge chunk columns, where the clamped index map loaded dummy rows)."""
-    h = top.shape[0]
-    nz = chunk.shape[1]
-    if not periodic:
-        top = jnp.where(sub_top, jnp.full_like(top, bc), top)
-        bot = jnp.where(sub_bot, jnp.full_like(bot, bc), bot)
-    rows = jnp.concatenate([top, chunk, bot], axis=0)  # (by+2h, nz)
+def _store_framed_plane(ring, k, chunk, top, bot, bc, periodic, h):
+    """Write the ghost-framed plane (by+2h, nz+2h) for ring slot ``k``
+    directly into the scratch via slice stores — one bulk chunk store plus
+    narrow row/lane edge stores — instead of materializing it with two
+    full-plane concatenates and then copying it into the ring (the VMEM
+    passes that made the fused kernels compute-bound, BASELINE.md traffic
+    model). The lane ghosts are read back from the ring after the row
+    stores (Pallas refs have sequential semantics), so periodic corners
+    wrap exactly as the concatenate construction did."""
+    by, nz = chunk.shape
+    ring[k, h : h + by, h : h + nz] = chunk
+    ring[k, 0:h, h : h + nz] = top
+    ring[k, h + by :, h : h + nz] = bot
     if periodic:
-        left = rows[:, nz - h :]
-        right = rows[:, :h]
+        ring[k, :, 0:h] = ring[k, :, nz : nz + h]
+        ring[k, :, h + nz :] = ring[k, :, h : 2 * h]
     else:
-        left = jnp.full((rows.shape[0], h), bc, rows.dtype)
-        right = left
-    return jnp.concatenate([left, rows, right], axis=1)  # (by+2h, nz+2h)
+        edge = jnp.full((by + 2 * h, h), bc, chunk.dtype)
+        ring[k, :, 0:h] = edge
+        ring[k, :, h + nz :] = edge
+
+
+def _store_input_plane(ring, k, chunk, top, bot, bc, periodic, h, ghost_x):
+    """Ring-slot store for one input plane: the framed plane, or (Dirichlet
+    only) a pure-bc plane on the conceptual domain ghost planes — gated with
+    pl.when rather than a per-step full-plane select. ``ghost_x`` is the
+    scalar predicate marking those planes (ignored when periodic: wrapped
+    planes are genuine data)."""
+    if periodic:
+        _store_framed_plane(ring, k, chunk, top, bot, bc, True, h)
+        return
+
+    @pl.when(ghost_x)
+    def _bc_plane():
+        ring[k] = jnp.full(
+            (chunk.shape[0] + 2 * h, chunk.shape[1] + 2 * h), bc, chunk.dtype
+        )
+
+    @pl.when(jnp.logical_not(ghost_x))
+    def _real_plane():
+        _store_framed_plane(ring, k, chunk, top, bot, bc, False, h)
 
 
 # Tap accumulation shared with the exchange-path kernels: op order must stay
@@ -213,26 +236,22 @@ def _direct_kernel(
 
     chunk = u_ref[0]  # (by, nz) aligned
     top, bot = _chunk_ghost_rows(chunk, top_ref, bot_ref, 1, periodic, bc)
-    plane = _assemble_plane(
-        chunk,
-        top,
-        bot,
-        bc,
-        periodic,
-        sub_top=j == 0,
-        sub_bot=j == n_chunks - 1,
-    )
     if not periodic:
-        # Conceptual planes -1 and nx are domain ghost planes: the clamped
-        # load fetched plane 0 / nx-1; overwrite with the boundary value.
-        ghost_x = jnp.logical_or(i == 0, i == nx + 1)
-        plane = jnp.where(ghost_x, jnp.full_like(plane, bc), plane)
+        # domain-edge chunk columns: the clamped row loads fetched dummy
+        # rows; substitute the Dirichlet boundary value (narrow blocks only)
+        top = jnp.where(j == 0, jnp.full_like(top, bc), top)
+        bot = jnp.where(j == n_chunks - 1, jnp.full_like(bot, bc), bot)
 
     for k in range(3):
 
         @pl.when(jax.lax.rem(i, 3) == k)
         def _store(k=k):
-            ring[k] = plane
+            # Conceptual planes -1 and nx are domain ghost planes: the
+            # clamped load fetched plane 0 / nx-1; store a pure-bc plane.
+            _store_input_plane(
+                ring, k, chunk, top, bot, bc, periodic, 1,
+                ghost_x=jnp.logical_or(i == 0, i == nx + 1),
+            )
 
     for k in range(3):
 
@@ -352,28 +371,21 @@ def _direct2_kernel(
     j = pl.program_id(0)
     i = pl.program_id(1)
     bc_s = u_ref.dtype.type(bc_value)
-    bc_c = compute_dtype(bc_value)
 
     chunk = u_ref[0]  # (by, nz)
     top, bot = _chunk_ghost_rows(chunk, top_ref, bot_ref, 2, periodic, bc_s)
-    plane = _assemble_plane(
-        chunk,
-        top,
-        bot,
-        bc_s,
-        periodic,
-        sub_top=j == 0,
-        sub_bot=j == n_chunks - 1,
-    )  # (by+4, nz+4)
     if not periodic:
-        ghost_x = jnp.logical_or(i <= 1, i >= nx + 2)
-        plane = jnp.where(ghost_x, jnp.full_like(plane, bc_s), plane)
+        top = jnp.where(j == 0, jnp.full_like(top, bc_s), top)
+        bot = jnp.where(j == n_chunks - 1, jnp.full_like(bot, bc_s), bot)
 
     for k in range(3):
 
         @pl.when(jax.lax.rem(i, 3) == k)
         def _load(k=k):
-            ring_a[k] = plane
+            _store_input_plane(
+                ring_a, k, chunk, top, bot, bc_s, periodic, 2,
+                ghost_x=jnp.logical_or(i <= 1, i >= nx + 2),
+            )
 
     # (b) intermediate plane m = i-2 from input planes (i-2, i-1, i).
     for k in range(3):  # k == i % 3
@@ -388,25 +400,40 @@ def _direct2_kernel(
             mid = _plane_taps(
                 planes, taps_flat, by + 2, nz + 2, compute_dtype
             )
-            if not periodic:
+            slot = (k + 1) % 3  # slot (i-2)%3
+            if periodic:
+                # round-trip through storage dtype so fused == unfused bitwise
+                ring_b[slot] = mid.astype(storage_dtype)
+            else:
                 m = i - 2  # 0 .. nx+1 in 1-ring coords; 0 / nx+1 = ghosts
                 ghost_plane = jnp.logical_or(m == 0, m == nx + 1)
-                row = jax.lax.broadcasted_iota(jnp.int32, (by + 2, 1), 0)
-                col = jax.lax.broadcasted_iota(jnp.int32, (1, nz + 2), 1)
-                # domain ghost rows exist only on the edge chunk columns;
-                # interior chunk borders hold genuinely-updated cells
-                ring_mask = jnp.logical_or(
-                    jnp.logical_or(
-                        jnp.logical_and(row == 0, j == 0),
-                        jnp.logical_and(row == by + 1, j == n_chunks - 1),
-                    ),
-                    jnp.logical_or(col == 0, col == nz + 1),
-                )
-                mid = jnp.where(
-                    jnp.logical_or(ghost_plane, ring_mask), bc_c, mid
-                )
-            # round-trip through storage dtype so fused == unfused bitwise
-            ring_b[(k + 1) % 3] = mid.astype(storage_dtype)  # slot (i-2)%3
+
+                @pl.when(ghost_plane)
+                def _bc_mid():
+                    ring_b[slot] = jnp.full(
+                        (by + 2, nz + 2), bc_s, storage_dtype
+                    )
+
+                @pl.when(jnp.logical_not(ghost_plane))
+                def _real_mid():
+                    # domain ghost ring of the intermediate, pinned by
+                    # narrow stores after the bulk store; ghost ROWS exist
+                    # only on the edge chunk columns (interior chunk
+                    # borders hold genuinely-updated cells), ghost lane
+                    # columns 0 / nz+1 always
+                    ring_b[slot] = mid.astype(storage_dtype)
+                    edge_col = jnp.full((by + 2, 1), bc_s, storage_dtype)
+                    ring_b[slot, :, 0:1] = edge_col
+                    ring_b[slot, :, nz + 1 : nz + 2] = edge_col
+                    edge_row = jnp.full((1, nz + 2), bc_s, storage_dtype)
+
+                    @pl.when(j == 0)
+                    def _top_row():
+                        ring_b[slot, 0:1, :] = edge_row
+
+                    @pl.when(j == n_chunks - 1)
+                    def _bot_row():
+                        ring_b[slot, by + 1 : by + 2, :] = edge_row
 
     # (c) output plane o = i-4 from intermediate planes (i-4, i-3, i-2).
     for k in range(3):  # k == i % 3; (i-4)%3 == (k+2)%3, (i-3)%3 == k
