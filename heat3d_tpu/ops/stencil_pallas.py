@@ -33,7 +33,7 @@ except ImportError:  # pragma: no cover - older/newer pallas layouts
     _Element = None
 
 from heat3d_tpu.core.config import SolverConfig
-from heat3d_tpu.core.stencils import nonzero_taps
+from heat3d_tpu.core.stencils import STENCILS, nonzero_taps
 
 # VMEM working-set budget for one grid step. The hardware has ~16 MB; the
 # pipeline needs two in-flight input windows plus the output tile, and
@@ -66,7 +66,11 @@ def _vmem_step_bytes(
 
 
 def choose_blocks(
-    local_shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
+    local_shape: Tuple[int, int, int],
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    n_taps: int = 7,
+    compute_itemsize: int = 4,
 ) -> Optional[Tuple[int, int]]:
     """Pick (bx, by) output-tile sizes for a (nx, ny, nz) local block, or
     None if no x-tiling fits the VMEM budget. ``by`` is always ``ny``.
@@ -82,7 +86,14 @@ def choose_blocks(
     are unconstrained."""
     nx, ny, nz = local_shape
     for bx in _divisors_desc(nx, 256):
-        if _vmem_step_bytes(bx, ny, nz, in_itemsize, out_itemsize) <= _VMEM_STEP_BUDGET:
+        if (
+            _vmem_step_bytes(bx, ny, nz, in_itemsize, out_itemsize)
+            <= _VMEM_STEP_BUDGET
+            # 3D tap chain: ~n_taps live (bx, ny, nz) temporaries on the
+            # Mosaic scoped stack
+            and bx * _tap_stack_bytes(ny, nz, n_taps, compute_itemsize)
+            <= _TAP_STACK_BUDGET
+        ):
             return bx, ny
     return None
 
@@ -95,6 +106,8 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
     if jnp.dtype(cfg.precision.storage).itemsize not in (2, 4):
         return False, f"unsupported storage dtype {cfg.precision.storage}"
     itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    n_taps = STENCILS[cfg.stencil.kind].num_taps
+    c_item = jnp.dtype(cfg.precision.compute).itemsize
     import os
 
     if (
@@ -114,20 +127,24 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
         # windowed kernel instead of falling back
         from heat3d_tpu.ops.stencil_pallas_direct import direct_supported
 
-        d1 = direct_supported(cfg.local_shape, 1, itemsize, itemsize)
+        d1 = direct_supported(
+            cfg.local_shape, 1, itemsize, itemsize, n_taps, c_item
+        )
         if cfg.time_blocking == 1 and d1:
             return True, ""
         if (
             cfg.time_blocking == 2
             and d1
-            and direct_supported(cfg.local_shape, 2, itemsize, itemsize)
+            and direct_supported(
+                cfg.local_shape, 2, itemsize, itemsize, n_taps, c_item
+            )
         ):
             return True, ""
-    if stream_supported(cfg.local_shape, itemsize, itemsize):
+    if stream_supported(cfg.local_shape, itemsize, itemsize, n_taps, c_item):
         return True, ""  # streaming kernel: no Element windows needed
     if _Element is None:
         return False, "pallas Element block dims unavailable in this jax"
-    blocks = choose_blocks(cfg.local_shape, itemsize, itemsize)
+    blocks = choose_blocks(cfg.local_shape, itemsize, itemsize, n_taps, c_item)
     if blocks is None:
         return False, f"no streaming ring or block tiling of {cfg.local_shape} fits VMEM"
     return True, ""
@@ -148,11 +165,42 @@ def _stream_vmem_bytes(
 # ~16 MB VMEM.
 _STREAM_VMEM_BUDGET = 12 * 1024 * 1024
 
+# Mosaic reserves scoped-VMEM stack for the tap chain's plane-sized
+# compute-dtype temporaries — empirically ~n_taps live planes (the 27-tap
+# chain at 512x512 fp32 planes reserved 34.4 MB against the chip's 16 MB
+# scoped limit and failed to compile; the budget leaves margin for the
+# model's ~20% underestimate of that measurement). Shared by every kernel
+# family: the streaming kernels here cannot shrink their full-extent-y
+# planes, so an over-budget chain makes them unsupported (callers fall
+# back); the direct kernels shrink their chunk height instead.
+_TAP_STACK_BUDGET = 11 * 1024 * 1024
+
+
+def _tap_stack_bytes(
+    rows: int, lanes: int, n_taps: int, compute_itemsize: int = 4
+) -> int:
+    return (
+        n_taps
+        * _round_up(rows, _SUBLANE)
+        * _round_up(lanes, _LANE)
+        * compute_itemsize
+    )
+
 
 def stream_supported(
-    shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
+    shape: Tuple[int, int, int],
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    n_taps: int = 7,
+    compute_itemsize: int = 4,
 ) -> bool:
-    return _stream_vmem_bytes(shape, in_itemsize, out_itemsize) <= _STREAM_VMEM_BUDGET
+    ny, nz = shape[1], shape[2]
+    return (
+        _stream_vmem_bytes(shape, in_itemsize, out_itemsize)
+        <= _STREAM_VMEM_BUDGET
+        and _tap_stack_bytes(ny, nz, n_taps, compute_itemsize)
+        <= _TAP_STACK_BUDGET
+    )
 
 
 def _stream_kernel(in_ref, out_ref, scratch, *, taps_by_di, ny, nz,
@@ -252,9 +300,19 @@ def _stream2_vmem_bytes(
 
 
 def stream2_supported(
-    shape: Tuple[int, int, int], in_itemsize: int = 4, out_itemsize: int = 4
+    shape: Tuple[int, int, int],
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    n_taps: int = 7,
+    compute_itemsize: int = 4,
 ) -> bool:
-    return _stream2_vmem_bytes(shape, in_itemsize, out_itemsize) <= 13 * 1024 * 1024
+    ny, nz = shape[1], shape[2]
+    return (
+        _stream2_vmem_bytes(shape, in_itemsize, out_itemsize)
+        <= 13 * 1024 * 1024
+        and _tap_stack_bytes(ny + 2, nz + 2, n_taps, compute_itemsize)
+        <= _TAP_STACK_BUDGET
+    )
 
 
 def _plane_taps(plane_values, taps_flat, ny, nz, compute_dtype):
@@ -445,8 +503,11 @@ def apply_taps_pallas(
     when its VMEM ring fits, else the windowed x-slab kernel."""
     nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
     out_dtype = out_dtype or up.dtype
+    tap_list = tuple(nonzero_taps(taps))
+    c_item = jnp.dtype(compute_dtype).itemsize
     if stream_supported(
-        (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+        (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
+        n_taps=len(tap_list), compute_itemsize=c_item,
     ):
         return apply_taps_pallas_stream(
             up, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
@@ -454,12 +515,12 @@ def apply_taps_pallas(
         )
     compute_dtype = jnp.dtype(compute_dtype).type
     blocks = choose_blocks(
-        (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+        (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
+        n_taps=len(tap_list), compute_itemsize=c_item,
     )
     if blocks is None:
         raise ValueError(f"no VMEM-feasible tiling for local shape {(nx, ny, nz)}")
     bx, by = blocks
-    tap_list = tuple(nonzero_taps(taps))
 
     kernel = functools.partial(
         _stencil_kernel,

@@ -58,6 +58,15 @@ _SUBLANE = 8
 # Leave Mosaic headroom in the ~16 MB VMEM for spills and the semaphore pool.
 _VMEM_BUDGET = 10 * 1024 * 1024
 
+# The tap-chain scoped-stack budget and estimator are shared with the
+# exchange-path kernels (single source: stencil_pallas, where the
+# calibration measurement is documented). The chunk chooser bounds the
+# chain separately from the explicit ring/pipeline buffers.
+from heat3d_tpu.ops.stencil_pallas import (  # noqa: E402
+    _TAP_STACK_BUDGET,
+    _tap_stack_bytes as _tap_stack_bytes_2d,
+)
+
 
 def _round_up(n: int, m: int) -> int:
     return (n + m - 1) // m * m
@@ -65,6 +74,17 @@ def _round_up(n: int, m: int) -> int:
 
 def _plane_bytes(rows: int, lanes: int, itemsize: int) -> int:
     return _round_up(rows, _SUBLANE) * _round_up(lanes, _LANE) * itemsize
+
+
+def _tap_stack_bytes(
+    by: int, nz: int, halo: int, n_taps: int, compute_itemsize: int = 4
+) -> int:
+    """Scoped-stack estimate of one tap chain: the fused (halo=2) kernel's
+    widest chain is the intermediate plane, one ghost ring larger."""
+    r = halo - 1
+    return _tap_stack_bytes_2d(
+        by + 2 * r, nz + 2 * r, n_taps, compute_itemsize
+    )
 
 
 def _vmem_bytes(
@@ -90,9 +110,12 @@ def choose_chunk(
     halo: int = 1,
     in_itemsize: int = 4,
     out_itemsize: int = 4,
+    n_taps: int = 7,
+    compute_itemsize: int = 4,
 ) -> Optional[int]:
     """Largest y-chunk height ``by`` (a divisor of ny, multiple of 8 when
-    ny >= 8) whose working set fits the VMEM budget, or None."""
+    ny >= 8) whose working set fits the VMEM budget — both the explicit
+    ring/pipeline buffers and the tap chain's scoped stack — or None."""
     ny, nz = local_shape[1], local_shape[2]
     for by in range(ny, 0, -1):
         if ny % by:
@@ -102,7 +125,12 @@ def choose_chunk(
             # (_row_block_specs); only the full-extent single chunk may be
             # unaligned
             continue
-        if _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize) <= _VMEM_BUDGET:
+        if (
+            _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize)
+            <= _VMEM_BUDGET
+            and _tap_stack_bytes(by, nz, halo, n_taps, compute_itemsize)
+            <= _TAP_STACK_BUDGET
+        ):
             return by
     return None
 
@@ -112,12 +140,18 @@ def direct_supported(
     halo: int = 1,
     in_itemsize: int = 4,
     out_itemsize: int = 4,
+    n_taps: int = 7,
+    compute_itemsize: int = 4,
 ) -> bool:
     nx, ny, nz = local_shape
     if halo == 2 and (nx < 2 or ny < 2 or nz < 2):
         return False  # wrapped/clamped width-2 ghosts would alias interior
     return (
-        choose_chunk(local_shape, halo, in_itemsize, out_itemsize) is not None
+        choose_chunk(
+            local_shape, halo, in_itemsize, out_itemsize, n_taps,
+            compute_itemsize,
+        )
+        is not None
     )
 
 
@@ -289,13 +323,15 @@ def apply_taps_direct(
     nx, ny, nz = u.shape
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
     by = choose_chunk(
-        u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+        u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
+        n_taps=len(flat),
+        compute_itemsize=jnp.dtype(compute_dtype).itemsize,
     )
     if by is None:
         raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
     n_chunks = ny // by
-    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
 
     if periodic:
         x_of = lambda i: jax.lax.rem(i - 1 + nx, nx)
@@ -471,13 +507,15 @@ def apply_taps_direct2(
     nx, ny, nz = u.shape
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
     by = choose_chunk(
-        u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+        u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
+        n_taps=len(flat),
+        compute_itemsize=jnp.dtype(compute_dtype).itemsize,
     )
     if by is None:
         raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
     n_chunks = ny // by
-    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
 
     if periodic:
         x_of = lambda i: jax.lax.rem(i - 2 + 2 * nx, nx)

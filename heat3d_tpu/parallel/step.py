@@ -218,7 +218,11 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
     except ImportError:
         return None
     itemsize = jnp.dtype(cfg.precision.storage).itemsize
-    if not direct_supported(cfg.local_shape, halo, itemsize, itemsize):
+    n_taps = STENCILS[cfg.stencil.kind].num_taps
+    c_item = jnp.dtype(cfg.precision.compute).itemsize
+    if not direct_supported(
+        cfg.local_shape, halo, itemsize, itemsize, n_taps, c_item
+    ):
         return None
     import functools
 
@@ -612,9 +616,13 @@ def make_superstep_fn(
             )
 
             itemsize = jnp.dtype(cfg.precision.storage).itemsize
+            n_taps = STENCILS[cfg.stencil.kind].num_taps
+            c_item = jnp.dtype(cfg.precision.compute).itemsize
             if (
                 jax.devices()[0].platform == "tpu"
-                and stream2_supported(cfg.local_shape, itemsize, itemsize)
+                and stream2_supported(
+                    cfg.local_shape, itemsize, itemsize, n_taps, c_item
+                )
             ):
                 fused = apply_taps_pallas_stream2
         except ImportError:
