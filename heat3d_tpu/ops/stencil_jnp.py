@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from heat3d_tpu.core.config import BoundaryCondition, Precision
-from heat3d_tpu.core.stencils import nonzero_taps
+from heat3d_tpu.core.stencils import accumulate_taps, nonzero_taps
 
 
 def pad_local(
@@ -46,12 +46,22 @@ def apply_taps_padded(
     nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
     out_dtype = out_dtype or up.dtype
     upc = up.astype(compute_dtype)
-    acc = None
-    for (di, dj, dk), w in nonzero_taps(taps):
-        sl = upc[1 + di : 1 + di + nx, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
-        term = jnp.asarray(w, compute_dtype) * sl
-        acc = term if acc is None else acc + term
-    assert acc is not None, "stencil has no taps"
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+    assert flat, "stencil has no taps"
+    cache = {}
+
+    def term(di, dj, dk):
+        if di == "xsum":
+            if "p" not in cache:
+                cache["p"] = upc[0:nx] + upc[2 : 2 + nx]  # (nx, ny+2, nz+2)
+            return cache["p"][:, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+        return upc[
+            1 + di : 1 + di + nx, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz
+        ]
+
+    acc = accumulate_taps(
+        flat, term, lambda w: jnp.asarray(w, compute_dtype)
+    )
     return acc.astype(out_dtype)
 
 

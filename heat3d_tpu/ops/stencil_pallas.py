@@ -33,7 +33,7 @@ except ImportError:  # pragma: no cover - older/newer pallas layouts
     _Element = None
 
 from heat3d_tpu.core.config import SolverConfig
-from heat3d_tpu.core.stencils import STENCILS, nonzero_taps
+from heat3d_tpu.core.stencils import STENCILS, accumulate_taps, nonzero_taps
 
 # VMEM working-set budget for one grid step. The hardware has ~16 MB; the
 # pipeline needs two in-flight input windows plus the output tile, and
@@ -232,12 +232,9 @@ def _stream_kernel(in_ref, out_ref, scratch, *, taps_by_di, ny, nz,
                 0: scratch[(k + 2) % 3].astype(compute_dtype),
                 1: scratch[k].astype(compute_dtype),
             }
-            acc = None
-            for di, dj, dk, w in taps_by_di:
-                sl = planes[di][1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
-                term = compute_dtype(w) * sl
-                acc = term if acc is None else acc + term
-            out_ref[0] = acc.astype(out_dtype)
+            out_ref[0] = _plane_taps(
+                planes, taps_by_di, ny, nz, compute_dtype
+            ).astype(out_dtype)
 
 
 def apply_taps_pallas_stream(
@@ -317,13 +314,21 @@ def stream2_supported(
 
 def _plane_taps(plane_values, taps_flat, ny, nz, compute_dtype):
     """Apply the 3x3x3 taps to a dict of three x-planes, producing the
-    (ny, nz) update of the middle plane's interior window."""
-    acc = None
-    for di, dj, dk, w in taps_flat:
-        sl = plane_values[di][1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
-        term = compute_dtype(w) * sl
-        acc = term if acc is None else acc + term
-    return acc
+    (ny, nz) update of the middle plane's interior window, in the canonical
+    core.stencils.accumulate_taps order (shared with the jnp path so
+    cross-implementation comparisons agree to FMA rounding)."""
+    cache = {}
+
+    def term(di, dj, dk):
+        if di == "xsum":
+            if "p" not in cache:
+                cache["p"] = plane_values[-1] + plane_values[1]
+            src = cache["p"]
+        else:
+            src = plane_values[di]
+        return src[1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+
+    return accumulate_taps(taps_flat, term, compute_dtype)
 
 
 def _stream2_kernel(
@@ -479,14 +484,25 @@ def _stencil_kernel(in_ref, out_ref, *, taps, bx, by, nz, compute_dtype, out_dty
     of the VMEM window, so Mosaic sees a chain of vector FMAs (z shifts are
     lane shifts, y shifts sublane shifts, x shifts plane selects).
     """
-    acc = None
-    for (di, dj, dk), w in taps:
-        sl = in_ref[
+    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in taps)
+    cache = {}
+
+    def term(di, dj, dk):
+        if di == "xsum":
+            if "p" not in cache:
+                cache["p"] = in_ref[0:bx].astype(compute_dtype) + in_ref[
+                    2 : 2 + bx
+                ].astype(compute_dtype)
+            return cache["p"][:, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz]
+        if di == 0:
+            if "m" not in cache:
+                cache["m"] = in_ref[1 : 1 + bx].astype(compute_dtype)
+            return cache["m"][:, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz]
+        return in_ref[
             1 + di : 1 + di + bx, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz
         ].astype(compute_dtype)
-        term = compute_dtype(w) * sl
-        acc = term if acc is None else acc + term
-    out_ref[:] = acc.astype(out_dtype)
+
+    out_ref[:] = accumulate_taps(flat, term, compute_dtype).astype(out_dtype)
 
 
 def apply_taps_pallas(
