@@ -724,7 +724,12 @@ def make_converge_fn(
     iterate until the global L2 residual of one update drops below tol.
     The residual check runs every step inside lax.while_loop — the
     convergence-mode path (SURVEY.md §3.3; fixed-step benchmark mode never
-    syncs and uses make_multistep_fn instead)."""
+    syncs and uses make_multistep_fn instead).
+
+    This loop keeps the single-buffer carry (and its per-iteration XLA
+    copy, see _pingpong_loop): pairing steps would change the exit
+    semantics (residual is checked after EVERY update), and the per-step
+    psum sync dominates the copy anyway."""
     step_r = make_step_fn(cfg, mesh, compute_padded, with_residual=True)
 
     def run(u, max_steps, tol):
