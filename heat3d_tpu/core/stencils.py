@@ -129,6 +129,15 @@ def nonzero_taps(taps: np.ndarray):
                     yield (di, dj, dk), v
 
 
+def flat_taps(taps: np.ndarray):
+    """The canonical flattened tap tuple ``((di, dj, dk, w), ...)`` in
+    nonzero_taps order — the element order is load-bearing: it defines the
+    accumulation order contract of :func:`accumulate_taps` and the list
+    equality inside :func:`split_x_symmetric`. All backends must flatten
+    through here."""
+    return tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+
+
 def split_x_symmetric(taps_flat):
     """Factor an x-symmetric tap set: return (A, B) where A is the common
     (dj, dk, w) pattern of the di = ±1 planes and B the di = 0 pattern, or

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from heat3d_tpu.core.config import BoundaryCondition, Precision
-from heat3d_tpu.core.stencils import accumulate_taps, nonzero_taps
+from heat3d_tpu.core.stencils import accumulate_taps, flat_taps, nonzero_taps
 
 
 def pad_local(
@@ -46,7 +46,7 @@ def apply_taps_padded(
     nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
     out_dtype = out_dtype or up.dtype
     upc = up.astype(compute_dtype)
-    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+    flat = flat_taps(taps)
     assert flat, "stencil has no taps"
     cache = {}
 

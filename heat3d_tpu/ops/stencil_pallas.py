@@ -33,9 +33,9 @@ except ImportError:  # pragma: no cover - older/newer pallas layouts
     _Element = None
 
 from heat3d_tpu.core.config import SolverConfig
-from heat3d_tpu.core.stencils import STENCILS, accumulate_taps, nonzero_taps
+from heat3d_tpu.core.stencils import STENCILS, accumulate_taps, flat_taps, nonzero_taps
 
-# VMEM working-set budget for one grid step. The hardware has ~16 MB; the
+# VMEM working-set budget for one grid step, empirically tuned: the
 # pipeline needs two in-flight input windows plus the output tile, and
 # Mosaic wants headroom for spills, so aim the *per-step* set under ~5 MB.
 _VMEM_STEP_BUDGET = 5 * 1024 * 1024
@@ -161,18 +161,21 @@ def _stream_vmem_bytes(
     return 3 * plane_in + 2 * plane_in + 2 * plane_out
 
 
-# Streaming kernel budget: ring + pipeline must leave Mosaic headroom in the
-# ~16 MB VMEM.
+# Streaming kernel explicit-buffer budget (ring + pipeline), empirically
+# tuned to leave Mosaic headroom.
 _STREAM_VMEM_BUDGET = 12 * 1024 * 1024
 
-# Mosaic reserves scoped-VMEM stack for the tap chain's plane-sized
-# compute-dtype temporaries — empirically ~n_taps live planes (the 27-tap
-# chain at 512x512 fp32 planes reserved 34.4 MB against the chip's 16 MB
-# scoped limit and failed to compile; the budget leaves margin for the
-# model's ~20% underestimate of that measurement). Shared by every kernel
-# family: the streaming kernels here cannot shrink their full-extent-y
-# planes, so an over-budget chain makes them unsupported (callers fall
-# back); the direct kernels shrink their chunk height instead.
+# Mosaic reserves scoped-VMEM *stack* for the tap chain's plane-sized
+# compute-dtype temporaries — empirically ~n_taps live planes. The stack
+# pool is capped by the compiler at 16 MB (its default scoped-vmem limit
+# — a separate pool from the explicit ring/pipeline buffers above, which
+# is why explicit budget + stack budget may legitimately sum past 16):
+# the 27-tap chain at 512x512 fp32 planes reserved 34.4 MB against that
+# cap and failed to compile. The budget leaves margin for the model's
+# ~20% underestimate of that measurement. Shared by every kernel family:
+# the streaming kernels here cannot shrink their full-extent-y planes,
+# so an over-budget chain makes them unsupported (callers fall back);
+# the direct kernels shrink their chunk height instead.
 _TAP_STACK_BUDGET = 11 * 1024 * 1024
 
 
@@ -251,7 +254,7 @@ def apply_taps_pallas_stream(
     nx, ny, nz = nxp - 2, nyp - 2, nzp - 2
     out_dtype = out_dtype or up.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
-    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+    flat = flat_taps(taps)
 
     kernel = functools.partial(
         _stream_kernel,
@@ -441,7 +444,7 @@ def apply_taps_pallas_stream2(
     nx, ny, nz = up2.shape[0] - 4, up2.shape[1] - 4, up2.shape[2] - 4
     out_dtype = out_dtype or up2.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
-    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+    flat = flat_taps(taps)
     kernel = functools.partial(
         _stream2_kernel,
         taps_flat=flat,

@@ -50,12 +50,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from heat3d_tpu.core.stencils import nonzero_taps
+from heat3d_tpu.core.stencils import flat_taps, nonzero_taps
 
 _LANE = 128
 _SUBLANE = 8
 
-# Leave Mosaic headroom in the ~16 MB VMEM for spills and the semaphore pool.
+# Explicit ring/pipeline buffer budget, empirically tuned to leave Mosaic
+# headroom for spills and the semaphore pool.
 _VMEM_BUDGET = 10 * 1024 * 1024
 
 # The tap-chain scoped-stack budget and estimator are shared with the
@@ -323,7 +324,7 @@ def apply_taps_direct(
     nx, ny, nz = u.shape
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
-    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+    flat = flat_taps(taps)
     by = choose_chunk(
         u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
         n_taps=len(flat),
@@ -507,7 +508,7 @@ def apply_taps_direct2(
     nx, ny, nz = u.shape
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
-    flat = tuple((di, dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps))
+    flat = flat_taps(taps)
     by = choose_chunk(
         u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
         n_taps=len(flat),

@@ -407,3 +407,45 @@ def test_multistep_loop_is_device_side():
         jax.ShapeDtypeStruct((), jnp.int32),
     )
     assert "while" in lowered.as_text()
+
+
+@pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu", reason="needs the TPU compiler"
+)
+def test_multistep_pair_loop_compiles_copy_free():
+    """Regression for the round-2 profile finding: a single-buffer while
+    carry makes XLA clone the full volume every iteration (the stencil
+    custom-call cannot write into the buffer it reads; measured 38-49% of
+    step time). The ping-pong pair carry (_pingpong_loop) must compile to
+    a main loop body of exactly two stencil custom-calls and ZERO
+    full-volume copies; only the bounded trailing-remainder loops may
+    keep one."""
+    import re
+
+    n = 128
+    cfg = SolverConfig(grid=GridConfig.cube(n), mesh=MeshConfig(shape=(1, 1, 1)))
+    mesh = build_mesh(cfg.mesh)
+    run = make_multistep_fn(cfg, mesh)
+    u = jnp.ones((n, n, n), jnp.float32)
+    txt = (
+        jax.jit(run, donate_argnums=0)
+        .lower(u, jnp.int32(20))
+        .compile()
+        .as_text()
+    )
+    cur = None
+    copies: dict = {}
+    calls: dict = {}
+    for ln in txt.splitlines():
+        if ln.rstrip().endswith("{"):
+            cur = ln.split()[0]
+        if re.search(r"= f32\[%d,%d,%d\]\S* copy\(" % (n, n, n), ln):
+            copies[cur] = copies.get(cur, 0) + 1
+        if "custom-call" in ln:
+            calls[cur] = calls.get(cur, 0) + 1
+    pair_bodies = [c for c, k in calls.items() if k == 2]
+    assert pair_bodies, f"no two-call pair-loop body found in: {calls}"
+    for c in pair_bodies:
+        assert copies.get(c, 0) == 0, (
+            f"full-volume copy reappeared in pair-loop body {c}: {copies}"
+        )
