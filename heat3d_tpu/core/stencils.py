@@ -147,12 +147,26 @@ def split_x_symmetric(taps_flat):
     ``A⊗u[x-1] + A⊗u[x+1] == A⊗(u[x-1] + u[x+1])`` — one plane add replaces
     a whole second 2D tap pass, cutting the 27-point chain from 27
     slice-FMAs to 9 + 9 + 1 (measured +19–43% on chip). For the 7-point set
-    the saving is nil (A is a single tap), so the original chain — which
-    carries the measured headline numbers — is kept (the ``<= 7`` gate)."""
+    the flop saving is nil (A is a single tap), so by default the original
+    chain — which carries the measured headline numbers — is kept; setting
+    ``HEAT3D_FACTOR_7PT=1`` factors it anyway (fewer shifted slice reads —
+    an on-chip A/B knob, see the gate below)."""
+    import os
+
     by_di = {-1: [], 0: [], 1: []}
     for di, dj, dk, w in taps_flat:
         by_di[di].append((dj, dk, w))
-    if len(taps_flat) <= 7 or by_di[-1] != by_di[1] or not by_di[-1]:
+    # HEAT3D_FACTOR_7PT=1 extends the factoring to the 7-point set: the
+    # saving there is not flops (1 add + 7 FMA vs 7 FMA) but SHIFTS — the
+    # ±x taps become one unshifted FMA on the plane sum, trading two
+    # lane/sublane-rotated slice reads for an unshifted add. A/B knob for
+    # on-chip measurement; off by default (and for "", "0", "false") so
+    # the measured headline's op order is exactly the committed record's.
+    factor_7pt = os.environ.get("HEAT3D_FACTOR_7PT", "").lower() not in (
+        "", "0", "false",
+    )
+    min_taps = 1 if factor_7pt else 8
+    if len(taps_flat) < min_taps or by_di[-1] != by_di[1] or not by_di[-1]:
         return None
     return by_di[-1], by_di[0]
 
