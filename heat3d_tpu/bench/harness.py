@@ -23,7 +23,12 @@ from heat3d_tpu.core.config import SolverConfig
 from heat3d_tpu.models.heat3d import HeatSolver3D
 from heat3d_tpu.parallel.step import exchange
 from heat3d_tpu.parallel.topology import build_mesh, field_sharding
-from heat3d_tpu.utils.timing import force_sync, percentile, sync_overhead, time_fn
+from heat3d_tpu.utils.timing import (
+    force_sync,
+    percentile,
+    sync_overhead,
+    time_fn_batched,
+)
 
 
 def bench_throughput(
@@ -91,10 +96,17 @@ def bench_halo(
     cfg: SolverConfig,
     iters: int = 30,
     warmup: int = 3,
+    batch: int = 10,
 ) -> Dict:
-    """p50/p95 wall latency of one full 3D ghost exchange (6 faces via 3
+    """p50/p95 latency of one full 3D ghost exchange (6 faces via 3
     axis-ordered ppermute pairs) as its own XLA program — the judged
-    halo-exchange latency metric."""
+    halo-exchange latency metric.
+
+    Each sample amortizes ``batch`` asynchronously dispatched exchanges
+    per device sync (time_fn_batched), so the host round trip — ~75 ms
+    over the axon tunnel, which dwarfs a single exchange — contributes
+    rtt/batch per call instead of rtt, and the reported percentiles
+    measure device-side exchange latency."""
     mesh = build_mesh(cfg.mesh)
     sharding = field_sharding(mesh, cfg.mesh)
     spec = P(*cfg.mesh.axis_names)
@@ -114,9 +126,16 @@ def bench_halo(
         jnp.zeros(cfg.padded_shape, jnp.dtype(cfg.precision.storage)), sharding
     )
     rtt = sync_overhead(probe=jnp.zeros((8, 128)))
-    raw = time_fn(ex, u, warmup=warmup, iters=iters)
-    times = [max(t - rtt, 0.05 * t) for t in raw]
-    rtt_dominated = percentile(raw, 50) < 2 * rtt
+    # all `batch` in-flight outputs stay live on device until the sync;
+    # cap their total at ~1/4 of a 16 GB chip so large grids don't OOM a
+    # benchmark that used to run (padded field bytes per call)
+    out_bytes = u.size * u.dtype.itemsize
+    batch = max(1, min(batch, int(4e9 // max(out_bytes, 1))))
+    raw = time_fn_batched(ex, u, warmup=warmup, iters=iters, batch=batch)
+    # each per-call sample carries rtt/batch of host round trip; the
+    # honesty guard still refuses to fabricate sub-5% residuals
+    times = [max(t - rtt / batch, 0.05 * t) for t in raw]
+    rtt_dominated = percentile(raw, 50) * batch < 2 * rtt
     face_cells = (
         cfg.local_shape[1] * cfg.local_shape[2]
         + cfg.local_shape[0] * cfg.local_shape[2]
@@ -129,6 +148,7 @@ def bench_halo(
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "iters": iters,
+        "batch": batch,
         "p50_us": percentile(times, 50) * 1e6,
         "p95_us": percentile(times, 95) * 1e6,
         "min_us": min(times) * 1e6,

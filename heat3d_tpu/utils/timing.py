@@ -49,16 +49,33 @@ def time_fn(fn, *args, warmup: int = 1, iters: int = 5) -> List[float]:
     times so callers can take p50/p95 (the halo-latency metric).
 
     Note: each sample includes one host round trip; on high-RTT platforms
-    prefer amortizing many device-side iterations per sample (as
-    bench.harness.bench_throughput does with its multi-step compiled loop)
-    and subtract ``sync_overhead()`` from each sample."""
+    prefer ``time_fn_batched`` (as bench.harness.bench_halo does) or a
+    multi-iteration compiled loop (as bench_throughput does)."""
+    return time_fn_batched(fn, *args, warmup=warmup, iters=iters, batch=1)
+
+
+def time_fn_batched(
+    fn, *args, warmup: int = 1, iters: int = 5, batch: int = 10
+) -> List[float]:
+    """Per-call wall times amortized over ``batch`` asynchronously
+    dispatched calls per device sync. The host round trip is paid once per
+    batch instead of once per call — on high-RTT platforms (the axon
+    tunnel's ~75 ms) a per-call sync makes every ``time_fn`` sample
+    RTT-dominated, while the batched form measures device-side latency.
+    Execution on a single device is serialized in dispatch order, so
+    syncing the last output implies the whole batch completed. Returns
+    ``iters`` per-call averages; callers subtract ``sync_overhead()/batch``
+    per sample."""
     for _ in range(warmup):
         force_sync(fn(*args))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        force_sync(fn(*args))
-        times.append(time.perf_counter() - t0)
+        out = None
+        for _ in range(batch):
+            out = fn(*args)
+        force_sync(out)
+        times.append((time.perf_counter() - t0) / batch)
     return times
 
 
