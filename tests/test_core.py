@@ -187,3 +187,59 @@ def test_neighbor_rank_edges():
     assert dec.neighbor_rank(0, mesh, 0, -1, periodic=True) == 2
     assert dec.neighbor_rank(2, mesh, 0, +1, periodic=False) is None
     assert dec.neighbor_rank(1, mesh, 0, +1, periodic=False) == 2
+
+
+def test_split_x_symmetric_contract(monkeypatch):
+    from heat3d_tpu.core.stencils import flat_taps, split_x_symmetric
+
+    monkeypatch.delenv("HEAT3D_FACTOR_7PT", raising=False)
+    taps27 = stencil_taps(STENCILS["27pt"], 0.1, 0.05, (1.0, 1.0, 1.0))
+    sym = split_x_symmetric(flat_taps(taps27))
+    assert sym is not None
+    a_taps, b_taps = sym
+    assert len(a_taps) == 9 and len(b_taps) == 9
+    # A is exactly the shared +-x plane pattern, in nonzero_taps order
+    assert a_taps == [
+        (dj, dk, w) for (di, dj, dk), w in nonzero_taps(taps27) if di == -1
+    ]
+
+    # the 7-point set keeps the measured headline chain by default...
+    taps7 = stencil_taps(STENCILS["7pt"], 0.1, 0.05, (1.0, 1.0, 1.0))
+    assert split_x_symmetric(flat_taps(taps7)) is None
+    # ...and factors under the A/B knob (off-values stay off)
+    monkeypatch.setenv("HEAT3D_FACTOR_7PT", "1")
+    assert split_x_symmetric(flat_taps(taps7)) is not None
+    monkeypatch.setenv("HEAT3D_FACTOR_7PT", "0")
+    assert split_x_symmetric(flat_taps(taps7)) is None
+    monkeypatch.delenv("HEAT3D_FACTOR_7PT")
+
+    # an x-asymmetric set must never factor
+    flat = flat_taps(taps27)
+    broken = tuple(
+        (di, dj, dk, w * 2 if di == 1 else w) for di, dj, dk, w in flat
+    )
+    assert split_x_symmetric(broken) is None
+
+
+def test_accumulate_taps_factored_matches_plain():
+    from heat3d_tpu.core.stencils import accumulate_taps, flat_taps
+
+    rng = np.random.default_rng(7)
+    u = rng.standard_normal((5, 6, 7))
+    taps = stencil_taps(STENCILS["27pt"], 0.13, 0.04, (1.0, 1.0, 1.0))
+    flat = flat_taps(taps)
+    nx, ny, nz = u.shape[0] - 2, u.shape[1] - 2, u.shape[2] - 2
+
+    def term(di, dj, dk):
+        if di == "xsum":
+            src = u[0:nx] + u[2 : 2 + nx]
+        else:
+            src = u[1 + di : 1 + di + nx]
+        return src[:, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+
+    got = accumulate_taps(flat, term, float)
+    want = sum(
+        w * u[1 + di : 1 + di + nx, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+        for di, dj, dk, w in flat
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-13, atol=1e-14)
