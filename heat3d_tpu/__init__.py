@@ -13,7 +13,9 @@ idiomatic JAX/XLA/Pallas program:
 - MPI_Cart_create 3D Cartesian decomposition     -> ``jax.sharding.Mesh`` mapped onto
   the TPU torus (``parallel.topology``)
 - the mpirun driver + time-stepping loop         -> ``jax.distributed`` entrypoint and a
-  jit-compiled ``lax.fori_loop`` time loop (``models.heat3d``, ``cli``)
+  jit-compiled ``lax.fori_loop`` time loop (``models.heat3d``, ``cli``); the
+  pointer swap is a ping-pong pair carry that XLA compiles to copy-free
+  buffer alternation (``parallel.step._pingpong_loop``)
 
 The reference mount is empty in this environment (see SURVEY.md §0); the
 capability spec is BASELINE.json's north star and config matrix, and
@@ -33,7 +35,7 @@ from heat3d_tpu.core.config import (
 from heat3d_tpu.core.stencils import STENCILS, Stencil, stencil_taps
 from heat3d_tpu.models.heat3d import HeatSolver3D
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "BoundaryCondition",
