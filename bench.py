@@ -2,10 +2,10 @@
 
 Metric (BASELINE.json): Gcell-updates/sec/chip, 7-point Jacobi stencil, on
 the judged 1024^3 grid floor (BASELINE.json ``metric`` names 1024^3-4096^3;
-falls back to 512^3 if the chip's HBM can't hold the working set). Runs the
-framework's best single-chip settings: temporal blocking k=2 via the
-BC-fused direct Pallas kernel — two updates per HBM sweep of the unpadded
-field — proven equal to plain stepping by tests/test_pallas_direct.py and
+falls back to smaller grids if the chip can't run it). Runs the framework's
+best single-chip settings: temporal blocking k=2 via the BC-fused direct
+Pallas kernel — two updates per HBM sweep of the unpadded field — proven
+equal to plain stepping by tests/test_pallas_direct.py and
 tests/test_distributed.py.
 
 ``vs_baseline`` normalizes against the A100 + CUDA-aware-MPI per-chip
@@ -13,20 +13,63 @@ estimate from BASELINE.md's sanity band (no published reference numbers
 exist — BASELINE.json ``published`` is empty), pinned at 100 Gcell/s/chip,
 the middle of the 50-200 roofline band.
 
+Resilience contract (this artifact must NEVER die unparsed):
+- the backend is confirmed alive by a bounded subprocess probe with
+  retry/backoff BEFORE this process touches jax (a wedged axon tunnel
+  hangs ``jax.devices()`` forever — the round-2 rc=1/rc=124 failure mode);
+- any per-run exception walks a grid degradation ladder (1024 -> 768 ->
+  512 -> 256), recording ``fallback_reason``;
+- if the TPU never comes back, the bench re-runs itself on the virtual CPU
+  platform and emits the measured CPU number tagged
+  ``"error": "tpu_unavailable"`` — machine-readable either way.
+
 Env overrides: HEAT3D_BENCH_GRID (int, cube edge), HEAT3D_BENCH_STEPS,
 HEAT3D_BENCH_DTYPE (fp32|bf16), HEAT3D_BENCH_BACKEND (auto|jnp|pallas),
-HEAT3D_BENCH_TIME_BLOCKING (1|2: updates per halo exchange / HBM sweep).
+HEAT3D_BENCH_TIME_BLOCKING (1|2: updates per halo exchange / HBM sweep),
+HEAT3D_BENCH_PROBE_ATTEMPTS, HEAT3D_PROBE_TIMEOUT,
+HEAT3D_BENCH_PROBE_BACKOFF (seconds between failed probes).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
-
-import jax
+import time
 
 A100_BASELINE_GCELLS_PER_CHIP = 100.0
+
+# Degradation ladder below the judged 1024^3 floor: each rung is tried once
+# after ANY failure at the rung above (OOM, axon compile failure, ...), so
+# the only way the artifact carries no measurement is total backend loss —
+# which the CPU fallback below converts to a labeled CPU number.
+LADDER = (1024, 768, 512, 256)
+
+
+def _probe_with_retry():
+    """Bounded, killable backend probe with retry/backoff.
+
+    Defaults (3 x 60 s probes + 2 x 15 s backoff = 210 s worst case, plus
+    a <=900 s CPU fallback) are sized to finish — and print the JSON line —
+    inside typical outer harness timeouts; a wedged tunnel must degrade the
+    artifact, never leave it unparsed (the round-2 rc=124 mode).
+    """
+    from heat3d_tpu.utils.backendprobe import probe_platform
+
+    attempts = int(os.environ.get("HEAT3D_BENCH_PROBE_ATTEMPTS", "3"))
+    backoff = float(os.environ.get("HEAT3D_BENCH_PROBE_BACKOFF", "15"))
+    for i in range(attempts):
+        platform = probe_platform()
+        if platform is not None:
+            return platform
+        sys.stderr.write(
+            f"bench: backend probe {i + 1}/{attempts} failed"
+            + (f"; retrying in {backoff:.0f}s\n" if i + 1 < attempts else "\n")
+        )
+        if i + 1 < attempts:
+            time.sleep(backoff)
+    return None
 
 
 def _run(edge, steps, dtype, backend, time_blocking):
@@ -52,8 +95,68 @@ def _run(edge, steps, dtype, backend, time_blocking):
     return bench_throughput(cfg, steps=steps, warmup=1, repeats=3)
 
 
+def _emit(gcells, detail, error=None) -> int:
+    rec = {
+        "metric": "gcell_updates_per_sec_per_chip",
+        "value": round(gcells, 3),
+        "unit": "Gcell/s/chip",
+        "vs_baseline": round(gcells / A100_BASELINE_GCELLS_PER_CHIP, 4),
+        "detail": detail,
+    }
+    if error:
+        rec["error"] = error
+    print(json.dumps(rec))
+    return 0
+
+
+def _cpu_fallback(reason: str) -> int:
+    """TPU never answered: measure on the virtual CPU platform instead.
+
+    Re-execs this script in a child with the axon plugin disabled so the
+    wedged tunnel can't touch the measurement, then re-emits the child's
+    JSON line tagged with the error. A number labeled ``platform: cpu`` +
+    ``error: tpu_unavailable`` beats an unparseable traceback.
+    """
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HEAT3D_BENCH_CHILD"] = "1"
+    # FORCE a host-sized run: an inherited HEAT3D_BENCH_GRID of 1024 would
+    # send the CPU child after a 4 GiB working set
+    env["HEAT3D_BENCH_GRID"] = os.environ.get("HEAT3D_BENCH_CPU_GRID", "128")
+    env["HEAT3D_BENCH_STEPS"] = "10"
+    env["HEAT3D_BENCH_TIME_BLOCKING"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        sys.stderr.write(proc.stderr)
+        line = proc.stdout.strip().splitlines()[-1]
+        rec = json.loads(line)
+    except Exception as e:  # noqa: BLE001 - last line of defense
+        sys.stderr.write(f"bench: CPU fallback also failed: {e}\n")
+        return _emit(0.0, {"platform": "none"}, error=reason)
+    # merge, don't clobber, any failure the child itself diagnosed
+    child_err = rec.get("error")
+    rec["error"] = f"{reason}; child: {child_err}" if child_err else reason
+    rec.setdefault("detail", {})["cpu_fallback"] = True
+    print(json.dumps(rec))
+    return 0
+
+
 def main() -> int:
-    platform = jax.devices()[0].platform
+    if os.environ.get("HEAT3D_BENCH_CHILD"):
+        platform = "cpu"
+    else:
+        platform = _probe_with_retry()
+        if platform is None:
+            return _cpu_fallback("tpu_unavailable")
+
     on_tpu = platform == "tpu"
     edge = int(os.environ.get("HEAT3D_BENCH_GRID", 1024 if on_tpu else 128))
     steps = int(os.environ.get("HEAT3D_BENCH_STEPS", 50 if on_tpu else 10))
@@ -63,45 +166,44 @@ def main() -> int:
         os.environ.get("HEAT3D_BENCH_TIME_BLOCKING", "2" if on_tpu else "1")
     )
 
-    fell_back = False
-    try:
-        r = _run(edge, steps, dtype, backend, time_blocking)
-    except Exception as e:  # noqa: BLE001 - judge artifact must degrade, not die
-        msg = str(e)
-        oom = "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
-        if not (oom and edge > 512):
-            raise
-        # judged floor doesn't fit this chip's HBM: record the 512^3 number
-        edge, fell_back = 512, True
-        r = None
-    if r is None:
-        # retried OUTSIDE the except block: the handler's traceback would
-        # otherwise pin the OOM'd attempt's frames (and device buffers)
-        # through the rerun
-        r = _run(edge, steps, dtype, backend, time_blocking)
-
-    gcells = r["gcell_per_sec_per_chip"]
-    print(
-        json.dumps(
+    rungs = [edge] + [e for e in LADDER if e < edge]
+    fallback_reason = None
+    last_err = None  # formatted string only: keeping the exception object
+    # would pin the failed attempt's traceback frames (and their device
+    # buffers) across the retry at the next rung
+    for rung in rungs:
+        try:
+            r = _run(rung, steps, dtype, backend, time_blocking)
+        except Exception as e:  # noqa: BLE001 - degrade, never die unparsed
+            last_err = f"{type(e).__name__}: {str(e)[:200]}"
+            del e
+            sys.stderr.write(f"bench: {rung}^3 failed ({last_err}); stepping down\n")
+            if fallback_reason is None:
+                fallback_reason = last_err
+            continue
+        return _emit(
+            r["gcell_per_sec_per_chip"],
             {
-                "metric": "gcell_updates_per_sec_per_chip",
-                "value": round(gcells, 3),
-                "unit": "Gcell/s/chip",
-                "vs_baseline": round(gcells / A100_BASELINE_GCELLS_PER_CHIP, 4),
-                "detail": {
-                    "grid": edge,
-                    "steps": steps,
-                    "dtype": dtype,
-                    "backend": backend,
-                    "time_blocking": time_blocking,
-                    "platform": platform,
-                    "seconds": round(r["seconds_best"], 4),
-                    "oom_fallback": fell_back,
-                },
-            }
+                "grid": rung,
+                "steps": steps,
+                "dtype": dtype,
+                "backend": backend,
+                "time_blocking": time_blocking,
+                "platform": platform,
+                "seconds": round(r["seconds_best"], 4),
+                "fallback_reason": fallback_reason,
+            },
         )
+    # Every rung failed. If we're not already the CPU child, the backend
+    # itself likely died after a successful probe — fall back to a measured
+    # CPU number rather than reporting 0.0.
+    if not os.environ.get("HEAT3D_BENCH_CHILD"):
+        return _cpu_fallback(f"all_rungs_failed: {last_err}")
+    return _emit(
+        0.0,
+        {"platform": platform, "rungs_tried": rungs},
+        error=f"all_rungs_failed: {last_err}",
     )
-    return 0
 
 
 if __name__ == "__main__":

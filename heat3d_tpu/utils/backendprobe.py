@@ -1,0 +1,56 @@
+"""Bounded out-of-process JAX backend probes.
+
+Under the axon remote-TPU env (``PALLAS_AXON_POOL_IPS`` set) the first
+in-process ``jax.devices()`` initializes a tunnel that can hang
+*indefinitely* when the remote lease is wedged (SURVEY.md §7.0) — the
+round-2 failure mode that turned a working framework into two red driver
+artifacts. Every "is the backend alive / how many devices" decision must
+therefore happen in a killable subprocess, never in the calling process.
+
+One timeout knob serves all callers: ``HEAT3D_PROBE_TIMEOUT`` (seconds,
+default 60).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def probe_timeout(default: float = 60.0) -> float:
+    return float(os.environ.get("HEAT3D_PROBE_TIMEOUT", default))
+
+
+def _probe(code: str, timeout: Optional[float]) -> Optional[str]:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=probe_timeout() if timeout is None else timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if proc.returncode != 0:
+        return None
+    lines = proc.stdout.strip().splitlines()
+    return lines[-1] if lines else None
+
+
+def probe_platform(timeout: Optional[float] = None) -> Optional[str]:
+    """Default-backend platform name ('tpu', 'cpu', ...), or None if no
+    backend answers within the timeout."""
+    return _probe("import jax; print(jax.devices()[0].platform)", timeout)
+
+
+def probe_device_count(timeout: Optional[float] = None) -> Optional[int]:
+    """Device count of the default backend, or None if unreachable."""
+    out = _probe("import jax; print(len(jax.devices()))", timeout)
+    if out is None:
+        return None
+    try:
+        return int(out)
+    except ValueError:
+        return None
