@@ -13,21 +13,23 @@ estimate from BASELINE.md's sanity band (no published reference numbers
 exist — BASELINE.json ``published`` is empty), pinned at 100 Gcell/s/chip,
 the middle of the 50-200 roofline band.
 
-Resilience contract (this artifact must NEVER die unparsed):
-- the backend is confirmed alive by a bounded subprocess probe with
-  retry/backoff BEFORE this process touches jax (a wedged axon tunnel
-  hangs ``jax.devices()`` forever — the round-2 rc=1/rc=124 failure mode);
-- any per-run exception walks a grid degradation ladder (1024 -> 768 ->
-  512 -> 256), recording ``fallback_reason``;
-- if the TPU never comes back, the bench re-runs itself on the virtual CPU
-  platform and emits the measured CPU number tagged
-  ``"error": "tpu_unavailable"`` — machine-readable either way.
+Resilience contract (this artifact must NEVER die unparsed): the parent
+process NEVER touches jax. It probes the backend in a killable subprocess
+(retry/backoff), then runs every measurement rung in a killable child with
+a timeout — so even a backend that wedges AFTER a successful probe (the
+round-2 failure mode: jax init/compile hanging forever over the axon
+tunnel) costs one rung timeout, not the artifact. Failed/hung rungs walk a
+grid degradation ladder (1024 -> 768 -> 512 -> 256, recording
+``fallback_reason``); if the TPU never yields a number the bench measures
+on the virtual CPU platform and tags the line ``"error":
+"tpu_unavailable"`` — machine-readable either way.
 
 Env overrides: HEAT3D_BENCH_GRID (int, cube edge), HEAT3D_BENCH_STEPS,
 HEAT3D_BENCH_DTYPE (fp32|bf16), HEAT3D_BENCH_BACKEND (auto|jnp|pallas),
 HEAT3D_BENCH_TIME_BLOCKING (1|2: updates per halo exchange / HBM sweep),
 HEAT3D_BENCH_PROBE_ATTEMPTS, HEAT3D_PROBE_TIMEOUT,
-HEAT3D_BENCH_PROBE_BACKOFF (seconds between failed probes).
+HEAT3D_BENCH_PROBE_BACKOFF (seconds between failed probes),
+HEAT3D_BENCH_RUNG_TIMEOUT (seconds per measurement child).
 """
 
 from __future__ import annotations
@@ -41,20 +43,18 @@ import time
 A100_BASELINE_GCELLS_PER_CHIP = 100.0
 
 # Degradation ladder below the judged 1024^3 floor: each rung is tried once
-# after ANY failure at the rung above (OOM, axon compile failure, ...), so
-# the only way the artifact carries no measurement is total backend loss —
-# which the CPU fallback below converts to a labeled CPU number.
+# after ANY failure (OOM, axon compile failure, child hang/timeout, ...),
+# so the only way the artifact carries no TPU measurement is total backend
+# loss — which the CPU fallback converts to a labeled CPU number.
 LADDER = (1024, 768, 512, 256)
 
 
 def _probe_with_retry():
     """Bounded, killable backend probe with retry/backoff.
 
-    Defaults (3 x 60 s probes + 2 x 15 s backoff = 210 s worst case, plus
-    a <=900 s CPU fallback) are sized to finish — and print the JSON line —
-    inside typical outer harness timeouts; a wedged tunnel must degrade the
-    artifact, never leave it unparsed (the round-2 rc=124 mode).
-    """
+    Defaults (3 x 60 s probes + 2 x 15 s backoff = 210 s worst case) are
+    sized so probing plus one measurement rung finishes — and prints the
+    JSON line — inside typical outer harness timeouts."""
     from heat3d_tpu.utils.backendprobe import probe_platform
 
     attempts = int(os.environ.get("HEAT3D_BENCH_PROBE_ATTEMPTS", "3"))
@@ -72,7 +72,29 @@ def _probe_with_retry():
     return None
 
 
-def _run(edge, steps, dtype, backend, time_blocking):
+def _emit(rec) -> int:
+    print(json.dumps(rec))
+    return 0
+
+
+def _child_main() -> int:
+    """Measurement child: the ONLY process that touches jax.
+
+    Runs exactly one configuration (no ladder — the parent owns retry
+    policy) and prints one JSON line. A wedged backend hangs only this
+    killable child."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    edge = int(os.environ.get("HEAT3D_BENCH_GRID", 1024 if on_tpu else 128))
+    steps = int(os.environ.get("HEAT3D_BENCH_STEPS", 50 if on_tpu else 10))
+    dtype = os.environ.get("HEAT3D_BENCH_DTYPE", "fp32")
+    backend = os.environ.get("HEAT3D_BENCH_BACKEND", "auto")
+    time_blocking = int(
+        os.environ.get("HEAT3D_BENCH_TIME_BLOCKING", "2" if on_tpu else "1")
+    )
+
     from heat3d_tpu.bench.harness import bench_throughput
     from heat3d_tpu.core.config import (
         GridConfig,
@@ -92,118 +114,121 @@ def _run(edge, steps, dtype, backend, time_blocking):
         backend=backend,
         time_blocking=time_blocking,
     )
-    return bench_throughput(cfg, steps=steps, warmup=1, repeats=3)
-
-
-def _emit(gcells, detail, error=None) -> int:
-    rec = {
-        "metric": "gcell_updates_per_sec_per_chip",
-        "value": round(gcells, 3),
-        "unit": "Gcell/s/chip",
-        "vs_baseline": round(gcells / A100_BASELINE_GCELLS_PER_CHIP, 4),
-        "detail": detail,
-    }
-    if error:
-        rec["error"] = error
-    print(json.dumps(rec))
-    return 0
-
-
-def _cpu_fallback(reason: str) -> int:
-    """TPU never answered: measure on the virtual CPU platform instead.
-
-    Re-execs this script in a child with the axon plugin disabled so the
-    wedged tunnel can't touch the measurement, then re-emits the child's
-    JSON line tagged with the error. A number labeled ``platform: cpu`` +
-    ``error: tpu_unavailable`` beats an unparseable traceback.
-    """
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HEAT3D_BENCH_CHILD"] = "1"
-    # FORCE a host-sized run: an inherited HEAT3D_BENCH_GRID of 1024 would
-    # send the CPU child after a 4 GiB working set
-    env["HEAT3D_BENCH_GRID"] = os.environ.get("HEAT3D_BENCH_CPU_GRID", "128")
-    env["HEAT3D_BENCH_STEPS"] = "10"
-    env["HEAT3D_BENCH_TIME_BLOCKING"] = "1"
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=900,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        sys.stderr.write(proc.stderr)
-        line = proc.stdout.strip().splitlines()[-1]
-        rec = json.loads(line)
-    except Exception as e:  # noqa: BLE001 - last line of defense
-        sys.stderr.write(f"bench: CPU fallback also failed: {e}\n")
-        return _emit(0.0, {"platform": "none"}, error=reason)
-    # merge, don't clobber, any failure the child itself diagnosed
-    child_err = rec.get("error")
-    rec["error"] = f"{reason}; child: {child_err}" if child_err else reason
-    rec.setdefault("detail", {})["cpu_fallback"] = True
-    print(json.dumps(rec))
-    return 0
-
-
-def main() -> int:
-    if os.environ.get("HEAT3D_BENCH_CHILD"):
-        platform = "cpu"
-    else:
-        platform = _probe_with_retry()
-        if platform is None:
-            return _cpu_fallback("tpu_unavailable")
-
-    on_tpu = platform == "tpu"
-    edge = int(os.environ.get("HEAT3D_BENCH_GRID", 1024 if on_tpu else 128))
-    steps = int(os.environ.get("HEAT3D_BENCH_STEPS", 50 if on_tpu else 10))
-    dtype = os.environ.get("HEAT3D_BENCH_DTYPE", "fp32")
-    backend = os.environ.get("HEAT3D_BENCH_BACKEND", "auto")
-    time_blocking = int(
-        os.environ.get("HEAT3D_BENCH_TIME_BLOCKING", "2" if on_tpu else "1")
-    )
-
-    rungs = [edge] + [e for e in LADDER if e < edge]
-    fallback_reason = None
-    last_err = None  # formatted string only: keeping the exception object
-    # would pin the failed attempt's traceback frames (and their device
-    # buffers) across the retry at the next rung
-    for rung in rungs:
-        try:
-            r = _run(rung, steps, dtype, backend, time_blocking)
-        except Exception as e:  # noqa: BLE001 - degrade, never die unparsed
-            last_err = f"{type(e).__name__}: {str(e)[:200]}"
-            del e
-            sys.stderr.write(f"bench: {rung}^3 failed ({last_err}); stepping down\n")
-            if fallback_reason is None:
-                fallback_reason = last_err
-            continue
-        return _emit(
-            r["gcell_per_sec_per_chip"],
-            {
-                "grid": rung,
+    r = bench_throughput(cfg, steps=steps, warmup=1, repeats=3)
+    gcells = r["gcell_per_sec_per_chip"]
+    return _emit(
+        {
+            "metric": "gcell_updates_per_sec_per_chip",
+            "value": round(gcells, 3),
+            "unit": "Gcell/s/chip",
+            "vs_baseline": round(gcells / A100_BASELINE_GCELLS_PER_CHIP, 4),
+            "detail": {
+                "grid": edge,
                 "steps": steps,
                 "dtype": dtype,
                 "backend": backend,
                 "time_blocking": time_blocking,
                 "platform": platform,
                 "seconds": round(r["seconds_best"], 4),
-                "fallback_reason": fallback_reason,
             },
-        )
-    # Every rung failed. If we're not already the CPU child, the backend
-    # itself likely died after a successful probe — fall back to a measured
-    # CPU number rather than reporting 0.0.
-    if not os.environ.get("HEAT3D_BENCH_CHILD"):
-        return _cpu_fallback(f"all_rungs_failed: {last_err}")
-    return _emit(
-        0.0,
-        {"platform": platform, "rungs_tried": rungs},
-        error=f"all_rungs_failed: {last_err}",
+        }
     )
+
+
+def _measure_in_child(grid_edge=None, cpu=False):
+    """Run one measurement rung in a killable child; return its JSON record.
+
+    Raises on child failure, hang (timeout), or unparseable output."""
+    env = dict(os.environ)
+    env["HEAT3D_BENCH_CHILD"] = "1"
+    if grid_edge is not None:
+        env["HEAT3D_BENCH_GRID"] = str(grid_edge)
+    if cpu:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        # FORCE a host-sized run: an inherited HEAT3D_BENCH_GRID of 1024
+        # would send the CPU child after a 4 GiB working set
+        env["HEAT3D_BENCH_GRID"] = os.environ.get(
+            "HEAT3D_BENCH_CPU_GRID", "128"
+        )
+        env["HEAT3D_BENCH_STEPS"] = "10"
+        env["HEAT3D_BENCH_TIME_BLOCKING"] = "1"
+    timeout = float(os.environ.get("HEAT3D_BENCH_RUNG_TIMEOUT", "1200"))
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        err_lines = proc.stderr.strip().splitlines()
+        raise RuntimeError(
+            f"measurement child rc={proc.returncode}: "
+            f"{err_lines[-1] if err_lines else '?'}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    if os.environ.get("HEAT3D_BENCH_CHILD"):
+        return _child_main()
+
+    platform = _probe_with_retry()
+    if platform is None:
+        return _cpu_fallback("tpu_unavailable")
+
+    edge = int(
+        os.environ.get("HEAT3D_BENCH_GRID", 1024 if platform == "tpu" else 128)
+    )
+    rungs = [edge] + [e for e in LADDER if e < edge]
+    fallback_reason = None
+    last_err = None  # formatted string only — never the exception object
+    for rung in rungs:
+        try:
+            rec = _measure_in_child(grid_edge=rung)
+        except Exception as e:  # noqa: BLE001 - degrade, never die unparsed
+            last_err = f"{type(e).__name__}: {str(e)[:200]}"
+            del e
+            sys.stderr.write(
+                f"bench: {rung}^3 failed ({last_err}); stepping down\n"
+            )
+            if fallback_reason is None:
+                fallback_reason = last_err
+            continue
+        rec.setdefault("detail", {})["fallback_reason"] = fallback_reason
+        return _emit(rec)
+    # every rung failed/hung — the backend likely died after the probe;
+    # a measured CPU number beats reporting 0.0
+    return _cpu_fallback(f"all_rungs_failed: {last_err}")
+
+
+def _cpu_fallback(reason: str) -> int:
+    """TPU never answered: measure on the virtual CPU platform instead.
+
+    A number labeled ``platform: cpu`` + ``error: tpu_unavailable`` beats
+    an unparseable traceback."""
+    try:
+        rec = _measure_in_child(cpu=True)
+    except Exception as e:  # noqa: BLE001 - last line of defense
+        sys.stderr.write(f"bench: CPU fallback also failed: {e}\n")
+        return _emit(
+            {
+                "metric": "gcell_updates_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "Gcell/s/chip",
+                "vs_baseline": 0.0,
+                "detail": {"platform": "none"},
+                "error": reason,
+            }
+        )
+    # merge, don't clobber, any failure the child itself diagnosed
+    child_err = rec.get("error")
+    rec["error"] = f"{reason}; child: {child_err}" if child_err else reason
+    rec.setdefault("detail", {})["cpu_fallback"] = True
+    return _emit(rec)
 
 
 if __name__ == "__main__":

@@ -61,7 +61,13 @@ def scaling_rows(results: List[Dict]) -> List[Dict]:
     thr = [r for r in results if r["bench"] == "throughput"]
 
     def key(r):
-        return (r["stencil"], r["dtype"], r["backend"], r.get("time_blocking", 1))
+        return (
+            r["stencil"],
+            r["dtype"],
+            r.get("compute_dtype", "float32"),
+            r["backend"],
+            r.get("time_blocking", 1),
+        )
 
     def nchips(r):
         n = 1
@@ -82,6 +88,15 @@ def scaling_rows(results: List[Dict]) -> List[Dict]:
         for mode, ref_grid in (("strong", tuple(r["grid"])), ("weak", local)):
             b = base.get((key(r), ref_grid))
             if b is None or b <= 0:
+                # fail loudly, not silently: a pod-day sweep missing its
+                # 1-chip baselines must not render an empty table unnoticed
+                print(
+                    f"scaling_rows: skipping {mode} efficiency for "
+                    f"grid={r['grid']} mesh={r['mesh']} — no 1-chip "
+                    f"baseline at grid={list(ref_grid)} with (stencil, "
+                    f"dtype, compute_dtype, backend, tb)={key(r)}",
+                    file=sys.stderr,
+                )
                 continue
             rows.append(
                 {
@@ -112,9 +127,13 @@ def render(results: List[Dict]) -> str:
             "|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in thr:
+            dtype = r["dtype"]
+            compute = r.get("compute_dtype", "float32")
+            if compute != "float32":
+                dtype += f" (c={compute})"
             lines.append(
                 f"| {_fmt_grid(r['grid'])} | {r['stencil']} | "
-                f"{_fmt_mesh(r['mesh'])} | {r['dtype']} | {r['backend']} | "
+                f"{_fmt_mesh(r['mesh'])} | {dtype} | {r['backend']} | "
                 f"{r.get('time_blocking', 1)} | "
                 f"{r['steps']} | {r['gcell_per_sec']:.2f} | "
                 f"{r['gcell_per_sec_per_chip']:.2f} | "
@@ -143,14 +162,18 @@ def render(results: List[Dict]) -> str:
         lines += [
             "### Halo exchange (measured)",
             "",
-            "| Grid | Mesh | Dtype | p50 µs | p95 µs | min µs | bytes/device | RTT-dominated |",
-            "|---|---|---|---|---|---|---|---|",
+            "| Grid | Mesh | Dtype | p50 µs | p95 µs | min µs | bytes/device | ICI | RTT-dominated |",
+            "|---|---|---|---|---|---|---|---|---|",
         ]
         for r in halo:
+            # rows on a (1,1,1) mesh execute no collective — they measure
+            # the local pad/crop cost only, flagged in the ICI column
+            ici = r.get("ici", any(m > 1 for m in r["mesh"]))
             lines.append(
                 f"| {_fmt_grid(r['grid'])} | {_fmt_mesh(r['mesh'])} | "
                 f"{r['dtype']} | {r['p50_us']:.1f} | {r['p95_us']:.1f} | "
                 f"{r['min_us']:.1f} | {r['halo_bytes_per_device']} | "
+                f"{'yes' if ici else 'no (local only)'} | "
                 f"{'yes' if r.get('rtt_dominated') else 'no'} |"
             )
         lines.append("")

@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--dtype", choices=["fp32", "bf16"], default="fp32",
                    help="field storage dtype; residual always accumulates fp32")
+    p.add_argument("--compute-dtype", choices=["fp32", "bf16"], default="fp32",
+                   help="stencil compute dtype (bf16 halves VPU op width; "
+                   "A/B knob for whether bf16 throughput is VPU- or "
+                   "assembly-bound); residual still accumulates fp32")
     p.add_argument("--backend", choices=["auto", "jnp", "pallas"], default="auto")
     p.add_argument("--overlap", action="store_true",
                    help="overlap halo exchange with interior compute "
@@ -120,7 +124,12 @@ def config_from_args(args) -> SolverConfig:
             bc_value=args.bc_value,
         ),
         mesh=mesh,
-        precision=Precision.bf16() if args.dtype == "bf16" else Precision.fp32(),
+        precision=Precision(
+            storage="bfloat16" if args.dtype == "bf16" else "float32",
+            compute="bfloat16"
+            if getattr(args, "compute_dtype", "fp32") == "bf16"
+            else "float32",
+        ),
         run=RunConfig(
             num_steps=args.steps,
             tolerance=args.tol,
@@ -160,11 +169,18 @@ def _main(argv: Optional[List[str]] = None) -> int:
         cfg.grid.shape, cfg.stencil.kind, cfg.mesh.shape,
         cfg.precision.storage, cfg.backend, len(jax.devices()),
     )
-    if cfg.run.tolerance is not None and cfg.time_blocking != 1:
+    if (
+        cfg.run.tolerance is not None
+        and cfg.time_blocking != 1
+        and cfg.run.residual_every <= 1
+    ):
         log.warning(
-            "--time-blocking applies to the fixed-step loop only; "
-            "convergence mode (--tol) checks the residual every step and "
-            "runs single updates"
+            "--time-blocking is inactive in convergence mode without "
+            "--residual-every K>1: a per-step residual check forces single "
+            "updates. Pass --residual-every K with K-1 a multiple of the "
+            "blocking factor (the K-1 updates between residual checks run "
+            "as supersteps) to recover temporal blocking + the copy-free "
+            "carry"
         )
     solver = HeatSolver3D(cfg)
 

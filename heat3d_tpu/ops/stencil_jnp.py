@@ -50,14 +50,21 @@ def apply_taps_padded(
     assert flat, "stencil has no taps"
     cache = {}
 
-    def term(di, dj, dk):
+    def plane(di):  # (nx, ny+2, nz+2)
         if di == "xsum":
             if "p" not in cache:
-                cache["p"] = upc[0:nx] + upc[2 : 2 + nx]  # (nx, ny+2, nz+2)
-            return cache["p"][:, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
-        return upc[
-            1 + di : 1 + di + nx, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz
-        ]
+                cache["p"] = upc[0:nx] + upc[2 : 2 + nx]
+            return cache["p"]
+        return upc[1 + di : 1 + di + nx]
+
+    def term(di, dj, dk):
+        src = plane(di)
+        if dj == "ysum":
+            key = ("ys", di)
+            if key not in cache:  # (nx, ny, nz+2)
+                cache[key] = src[:, 0:ny] + src[:, 2 : 2 + ny]
+            return cache[key][:, :, 1 + dk : 1 + dk + nz]
+        return src[:, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
 
     acc = accumulate_taps(
         flat, term, lambda w: jnp.asarray(w, compute_dtype)

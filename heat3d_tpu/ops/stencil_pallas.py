@@ -329,6 +329,11 @@ def _plane_taps(plane_values, taps_flat, ny, nz, compute_dtype):
             src = cache["p"]
         else:
             src = plane_values[di]
+        if dj == "ysum":
+            key = ("ys", di)
+            if key not in cache:  # (ny, nz+2)
+                cache[key] = src[0:ny] + src[2 : 2 + ny]
+            return cache[key][:, 1 + dk : 1 + dk + nz]
         return src[1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
 
     return accumulate_taps(taps_flat, term, compute_dtype)
@@ -490,17 +495,27 @@ def _stencil_kernel(in_ref, out_ref, *, taps, bx, by, nz, compute_dtype, out_dty
     flat = tuple((di, dj, dk, w) for (di, dj, dk), w in taps)
     cache = {}
 
-    def term(di, dj, dk):
+    def plane(di):  # (bx, by+2, nz+2) in compute dtype; factored dis only
         if di == "xsum":
             if "p" not in cache:
                 cache["p"] = in_ref[0:bx].astype(compute_dtype) + in_ref[
                     2 : 2 + bx
                 ].astype(compute_dtype)
-            return cache["p"][:, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz]
-        if di == 0:
-            if "m" not in cache:
-                cache["m"] = in_ref[1 : 1 + bx].astype(compute_dtype)
-            return cache["m"][:, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz]
+            return cache["p"]
+        assert di == 0, di
+        if "m" not in cache:
+            cache["m"] = in_ref[1 : 1 + bx].astype(compute_dtype)
+        return cache["m"]
+
+    def term(di, dj, dk):
+        if dj == "ysum":  # only emitted for the factored planes (xsum, 0)
+            key = ("ys", di)
+            if key not in cache:  # (bx, by, nz+2)
+                src = plane(di)
+                cache[key] = src[:, 0:by] + src[:, 2 : 2 + by]
+            return cache[key][:, :, 1 + dk : 1 + dk + nz]
+        if di in ("xsum", 0):
+            return plane(di)[:, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz]
         return in_ref[
             1 + di : 1 + di + bx, 1 + dj : 1 + dj + by, 1 + dk : 1 + dk + nz
         ].astype(compute_dtype)

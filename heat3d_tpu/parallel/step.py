@@ -721,16 +721,49 @@ def make_converge_fn(
     compute_padded: LocalCompute = apply_taps_padded,
 ):
     """Build ``(u, max_steps, tol) -> (u, steps_taken, last_residual)``:
-    iterate until the global L2 residual of one update drops below tol.
-    The residual check runs every step inside lax.while_loop — the
-    convergence-mode path (SURVEY.md §3.3; fixed-step benchmark mode never
-    syncs and uses make_multistep_fn instead).
+    iterate until the global L2 residual of one update drops below tol —
+    the convergence-mode path (SURVEY.md §3.3; fixed-step benchmark mode
+    never syncs and uses make_multistep_fn instead).
 
-    This loop keeps the single-buffer carry (and its per-iteration XLA
-    copy, see _pingpong_loop): pairing steps would change the exit
-    semantics (residual is checked after EVERY update), and the per-step
-    psum sync dominates the copy anyway."""
+    With ``cfg.run.residual_every = K > 1`` the while body advances K-1
+    updates through the fixed-step machinery — the copy-free ping-pong
+    pair carry AND temporal-blocking supersteps both apply — then runs one
+    residual step, so the psum + its convergence check happen every K
+    updates instead of every update. This is exactly the reference class's
+    cadence ("every k iters: residual + MPI_Allreduce", SURVEY.md §3.2);
+    the run may overshoot the tol crossing by up to K-1 updates but never
+    exceeds max_steps, and ``steps_taken`` counts real updates exactly.
+
+    With K <= 1 (the default) the residual is checked after EVERY update:
+    single-buffer carry (its per-iteration XLA copy is dominated by the
+    per-step psum sync) and no temporal blocking."""
     step_r = make_step_fn(cfg, mesh, compute_padded, with_residual=True)
+    every = max(1, cfg.run.residual_every or 1)
+
+    if every > 1:
+        multistep = make_multistep_fn(cfg, mesh, compute_padded)
+
+        def run(u, max_steps, tol):
+            def cond(state):
+                _, i, r2 = state
+                return jnp.logical_and(i < max_steps, r2 > tol * tol)
+
+            def body(state):
+                u, i, _ = state
+                # leave one update for the residual step; never pass
+                # max_steps even when it isn't a multiple of K
+                n = jnp.minimum(jnp.int32(every - 1), max_steps - 1 - i)
+                u = multistep(u, n)
+                u_new, r2 = step_r(u)
+                return u_new, i + n + 1, r2
+
+            init = (
+                u, jnp.zeros((), jnp.int32), jnp.full((), jnp.inf, jnp.float32)
+            )
+            u, steps, r2 = lax.while_loop(cond, body, init)
+            return u, steps, jnp.sqrt(r2)
+
+        return run
 
     def run(u, max_steps, tol):
         def cond(state):

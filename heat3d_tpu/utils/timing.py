@@ -49,8 +49,8 @@ def time_fn(fn, *args, warmup: int = 1, iters: int = 5) -> List[float]:
     times so callers can take p50/p95 (the halo-latency metric).
 
     Note: each sample includes one host round trip; on high-RTT platforms
-    prefer ``time_fn_batched`` (as bench.harness.bench_halo does) or a
-    multi-iteration compiled loop (as bench_throughput does)."""
+    prefer a multi-iteration compiled loop (as bench.harness's
+    bench_throughput and bench_halo both do) or ``time_fn_batched``."""
     return time_fn_batched(fn, *args, warmup=warmup, iters=iters, batch=1)
 
 

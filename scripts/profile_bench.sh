@@ -3,7 +3,8 @@
 # device-time breakdown (VERDICT r1 item 2: attribute the roofline gap with
 # a trace, not guesses).
 #
-# Usage: [GRID=512] [STEPS=20] [TB=1] [DTYPE=fp32] scripts/profile_bench.sh [outdir]
+# Usage: [GRID=512] [STEPS=20] [TB=1] [DTYPE=fp32] [STENCIL=7pt]
+#        scripts/profile_bench.sh [outdir]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -12,10 +13,11 @@ GRID="${GRID:-512}"
 STEPS="${STEPS:-20}"
 TB="${TB:-1}"
 DTYPE="${DTYPE:-fp32}"
+STENCIL="${STENCIL:-7pt}"
 
 rm -rf "$OUT"
 python -m heat3d_tpu.bench --grid "$GRID" --steps "$STEPS" \
-  --time-blocking "$TB" --dtype "$DTYPE" --mesh 1 1 1 \
+  --time-blocking "$TB" --dtype "$DTYPE" --stencil "$STENCIL" --mesh 1 1 1 \
   --bench throughput --profile-dir "$OUT"
 
 python scripts/summarize_trace.py "$OUT"

@@ -32,16 +32,35 @@ for stencil in ${STENCILS:-7pt 27pt}; do
           [[ $grid -lt 512 ]] && continue
           [[ $dtype == bf16 && $tb == 1 ]] && continue
         fi
+        # halo latency depends only on (grid, dtype), not stencil/tb: emit
+        # one halo row per exchange shape (--bench all on the 7pt tb=1
+        # pass), throughput-only otherwise — no duplicate halo rows
+        bench=throughput
+        [[ $stencil == 7pt && $tb == 1 ]] && bench=all
         # a failing row (e.g. 1024^3 OOM on a small-HBM chip) skips, not aborts
         python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
           --stencil "$stencil" --dtype "$dtype" --time-blocking "$tb" \
-          --mesh 1 1 1 \
+          --mesh 1 1 1 --bench "$bench" \
           >> "$OUT" 2>/dev/null \
           || echo "suite: skipped $stencil grid=$grid dtype=$dtype tb=$tb (rc=$?)" >&2
       done
     done
   done
 done
+
+# bf16-COMPUTE A/B (judged config 5 follow-up): same bf16 storage, stencil
+# math in bf16 instead of fp32 — answers whether the bf16 tb=2 ceiling gap
+# is VPU-width-limited (this row speeds up) or plane-assembly-limited (it
+# doesn't). Accuracy gated by tests/test_solver.py bf16-compute tier.
+if [[ -z "${SKIP_BF16_COMPUTE:-}" ]]; then
+  for grid in ${GRIDS:-512 1024}; do
+    [[ $grid -lt 512 ]] && continue
+    python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
+      --dtype bf16 --compute-dtype bf16 --time-blocking 2 --mesh 1 1 1 \
+      --bench throughput >> "$OUT" 2>/dev/null \
+      || echo "suite: skipped bf16-compute grid=$grid (rc=$?)" >&2
+  done
+fi
 
 if [[ -z "${SKIP_OVERLAP:-}" ]]; then
   python -m heat3d_tpu.bench --grid "${OVERLAP_GRID:-512}" \

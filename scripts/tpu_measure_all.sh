@@ -41,11 +41,39 @@ for mode in direct exchange; do
   echo "$mode: $out" | tee -a "$LOG"
 done
 
+# The factored-default 27pt and bf16-compute rows are already in the
+# suite record (stage 2); these A/B stages log the counterfactual sides.
+echo "--- stage 3c: 27pt y-factoring A/B (512^3 fp32)" | tee -a "$LOG"
+for fy in 1 0; do
+  for tb in 1 2; do
+    out=$(env HEAT3D_FACTOR_Y=$fy timeout 1200 python -m heat3d_tpu.bench \
+      --grid 512 --steps 50 --stencil 27pt --time-blocking $tb \
+      --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
+    echo "factor_y=$fy tb=$tb: $out" | tee -a "$LOG"
+  done
+done
+
+echo "--- stage 3d: bf16-compute A/B (1024^3 tb=2)" | tee -a "$LOG"
+for cd in fp32 bf16; do
+  out=$(timeout 1200 python -m heat3d_tpu.bench --grid 1024 --steps 50 \
+    --dtype bf16 --compute-dtype $cd --time-blocking 2 --mesh 1 1 1 \
+    --bench throughput 2>&1 | tail -1)
+  echo "compute=$cd: $out" | tee -a "$LOG"
+done
+
 echo "--- stage 4: profile traces" | tee -a "$LOG"
 for tb in 1 2; do
   GRID=512 STEPS=20 TB=$tb timeout 1200 \
     bash scripts/profile_bench.sh "/tmp/heat3d_profile_tb$tb" 2>&1 \
     | tee -a "$LOG"
 done
+# 27pt VPU-bound claim: capture the op mix at the ceiling (VERDICT r2 #4)
+GRID=512 STEPS=20 TB=1 STENCIL=27pt timeout 1200 \
+  bash scripts/profile_bench.sh "/tmp/heat3d_profile_27pt" 2>&1 \
+  | tee -a "$LOG"
+
+# halo p50 rows (device-side k-exchange loop) come from stage 2's suite:
+# one row per (grid, dtype) exchange shape, labeled local-only on the
+# single-chip mesh — the ICI numbers need a pod slice.
 
 echo "=== done $(date -u +%FT%TZ) ===" | tee -a "$LOG"

@@ -37,6 +37,24 @@ def test_halo_result_shape():
     json.dumps(r)
 
 
+def test_run_suite_dedupes_halo_rows():
+    """Configs differing only in tb/backend/stencil share one halo row —
+    the halo latency depends only on the exchange shape."""
+    import dataclasses
+    import io
+
+    from heat3d_tpu.bench.harness import run_suite
+
+    cfg = tiny_cfg()
+    cfg2 = dataclasses.replace(cfg, time_blocking=2)
+    buf = io.StringIO()
+    results = run_suite([cfg, cfg2], steps=2, out=buf)
+    kinds = [r["bench"] for r in results]
+    assert kinds.count("throughput") == 2
+    assert kinds.count("halo") == 1
+    assert len(buf.getvalue().strip().splitlines()) == 3
+
+
 def test_report_renders_and_updates_markers(tmp_path):
     from heat3d_tpu.bench import report
 

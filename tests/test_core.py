@@ -221,7 +221,24 @@ def test_split_x_symmetric_contract(monkeypatch):
     assert split_x_symmetric(broken) is None
 
 
-def test_accumulate_taps_factored_matches_plain():
+def _ref_term(u):
+    """Reference implementation of the full term contract (xsum + ysum)."""
+    nx, ny, nz = u.shape[0] - 2, u.shape[1] - 2, u.shape[2] - 2
+
+    def term(di, dj, dk):
+        if di == "xsum":
+            src = u[0:nx] + u[2 : 2 + nx]
+        else:
+            src = u[1 + di : 1 + di + nx]
+        if dj == "ysum":
+            row = src[:, 0:ny] + src[:, 2 : 2 + ny]
+            return row[:, :, 1 + dk : 1 + dk + nz]
+        return src[:, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
+
+    return term
+
+
+def test_accumulate_taps_factored_matches_plain(monkeypatch):
     from heat3d_tpu.core.stencils import accumulate_taps, flat_taps
 
     rng = np.random.default_rng(7)
@@ -230,16 +247,53 @@ def test_accumulate_taps_factored_matches_plain():
     flat = flat_taps(taps)
     nx, ny, nz = u.shape[0] - 2, u.shape[1] - 2, u.shape[2] - 2
 
-    def term(di, dj, dk):
-        if di == "xsum":
-            src = u[0:nx] + u[2 : 2 + nx]
-        else:
-            src = u[1 + di : 1 + di + nx]
-        return src[:, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
-
-    got = accumulate_taps(flat, term, float)
     want = sum(
         w * u[1 + di : 1 + di + nx, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
         for di, dj, dk, w in flat
     )
-    np.testing.assert_allclose(got, want, rtol=1e-13, atol=1e-14)
+    # both factoring levels and the unfactored-y variant agree with plain
+    for fy in ("1", "0"):
+        monkeypatch.setenv("HEAT3D_FACTOR_Y", fy)
+        got = accumulate_taps(flat, _ref_term(u), float)
+        np.testing.assert_allclose(got, want, rtol=1e-13, atol=1e-14)
+
+
+def test_split_y_symmetric_contract():
+    from heat3d_tpu.core.stencils import (
+        flat_taps,
+        split_x_symmetric,
+        split_y_symmetric,
+    )
+
+    taps27 = stencil_taps(STENCILS["27pt"], 0.1, 0.05, (1.0, 1.0, 1.0))
+    a_taps, b_taps = split_x_symmetric(flat_taps(taps27))
+    for plane in (a_taps, b_taps):  # both 27pt planes are y-symmetric 3x3
+        r, m = split_y_symmetric(plane)
+        assert len(r) == 3 and len(m) == 3
+        assert r == [(dk, w) for dj, dk, w in plane if dj == -1]
+    # a y-asymmetric plane must never factor
+    broken = [(dj, dk, w * 2 if dj == 1 else w) for dj, dk, w in a_taps]
+    assert split_y_symmetric(broken) is None
+
+
+def test_accumulate_taps_y_factoring_op_counts(monkeypatch):
+    """The factored 27pt chain emits 12 terms (3+3 per plane) with y-
+    factoring on, 18 with it off — the measurable op-count contract."""
+    from heat3d_tpu.core.stencils import accumulate_taps, flat_taps
+
+    taps = stencil_taps(STENCILS["27pt"], 0.13, 0.04, (1.0, 1.0, 1.0))
+    flat = flat_taps(taps)
+    u = np.random.default_rng(3).standard_normal((5, 6, 7))
+
+    for fy, n_terms, n_ysum in (("1", 12, 6), ("0", 18, 0)):
+        calls = []
+        ref = _ref_term(u)
+
+        def term(di, dj, dk, ref=ref):
+            calls.append((di, dj, dk))
+            return ref(di, dj, dk)
+
+        monkeypatch.setenv("HEAT3D_FACTOR_Y", fy)
+        accumulate_taps(flat, term, float)
+        assert len(calls) == n_terms, (fy, calls)
+        assert sum(c[1] == "ysum" for c in calls) == n_ysum

@@ -53,12 +53,70 @@ def test_bf16_solver_tracks_fp32():
     assert np.max(np.abs(a - b)) < 0.05 * max(1.0, np.max(np.abs(b)))
 
 
+def test_bf16_compute_solver_tracks_fp32():
+    """bf16 COMPUTE (not just storage) stays within the same accuracy gate
+    as bf16 storage — the correctness side of the suite's bf16-compute A/B
+    throughput row (storage round-trips already quantize each step, so
+    bf16 tap math adds at most the same order of rounding)."""
+    s16, cfg = make_solver(
+        precision=Precision(
+            storage="bfloat16", compute="bfloat16", residual="float32"
+        )
+    )
+    s32, _ = make_solver(precision=Precision.fp32())
+    u16 = s16.run(s16.init_state("gaussian"), 5)
+    u32 = s32.run(s32.init_state("gaussian"), 5)
+    a = s16.gather(u16).astype(np.float32)
+    b = s32.gather(u32)
+    assert np.max(np.abs(a - b)) < 0.05 * max(1.0, np.max(np.abs(b)))
+
+
 def test_convergence_mode():
     solver, _ = make_solver()
     u = solver.init_state("gaussian")
     res = solver.run_to_convergence(u, tol=1e-3, max_steps=5000)
     assert res.residual is not None and res.residual <= 1e-3
     assert 0 < res.steps < 5000
+
+
+def test_convergence_residual_every():
+    """--residual-every K>1 convergence: same physics, checks every K
+    updates through the copy-free fixed-step machinery; may overshoot the
+    tol crossing by < K updates, never max_steps."""
+    from heat3d_tpu.core.config import RunConfig
+
+    s1, _ = make_solver()
+    sk, _ = make_solver(run=RunConfig(residual_every=4))
+    u1 = s1.init_state("gaussian")
+    uk = sk.init_state("gaussian")
+    r1 = s1.run_to_convergence(u1, tol=1e-3, max_steps=5000)
+    rk = sk.run_to_convergence(uk, tol=1e-3, max_steps=5000)
+    assert rk.residual <= 1e-3
+    assert r1.steps <= rk.steps < r1.steps + 4
+    # the K-cadence trajectory is the same physics: state after rk.steps
+    # fixed steps == the converged state
+    want = s1.gather(s1.run(s1.init_state("gaussian"), rk.steps))
+    np.testing.assert_allclose(sk.gather(rk.u), want, rtol=1e-6, atol=1e-7)
+
+
+def test_convergence_residual_every_with_time_blocking():
+    from heat3d_tpu.core.config import RunConfig
+
+    sk, _ = make_solver(run=RunConfig(residual_every=4), time_blocking=2)
+    s1, _ = make_solver()
+    rk = sk.run_to_convergence(sk.init_state("gaussian"), tol=1e-3, max_steps=5000)
+    assert rk.residual <= 1e-3
+    want = s1.gather(s1.run(s1.init_state("gaussian"), rk.steps))
+    np.testing.assert_allclose(sk.gather(rk.u), want, rtol=1e-6, atol=1e-7)
+
+
+def test_convergence_residual_every_respects_max_steps():
+    from heat3d_tpu.core.config import RunConfig
+
+    sk, _ = make_solver(run=RunConfig(residual_every=7))
+    # max_steps not a multiple of K: must stop exactly at max_steps
+    rk = sk.run_to_convergence(sk.init_state("gaussian"), tol=0.0, max_steps=10)
+    assert rk.steps == 10
 
 
 def test_checkpoint_roundtrip(tmp_path):
