@@ -29,7 +29,9 @@ HEAT3D_BENCH_DTYPE (fp32|bf16), HEAT3D_BENCH_BACKEND (auto|jnp|pallas),
 HEAT3D_BENCH_TIME_BLOCKING (1|2: updates per halo exchange / HBM sweep),
 HEAT3D_BENCH_PROBE_ATTEMPTS, HEAT3D_PROBE_TIMEOUT,
 HEAT3D_BENCH_PROBE_BACKOFF (seconds between failed probes),
-HEAT3D_BENCH_RUNG_TIMEOUT (seconds per measurement child).
+HEAT3D_BENCH_RUNG_TIMEOUT (seconds per measurement child),
+HEAT3D_BENCH_DEADLINE (overall wall-clock budget, seconds — rung timeouts
+shrink to fit so the JSON line always lands inside it).
 """
 
 from __future__ import annotations
@@ -47,6 +49,20 @@ A100_BASELINE_GCELLS_PER_CHIP = 100.0
 # so the only way the artifact carries no TPU measurement is total backend
 # loss — which the CPU fallback converts to a labeled CPU number.
 LADDER = (1024, 768, 512, 256)
+
+# Overall wall-clock budget. Without it, probe-OK-then-every-child-hangs
+# costs 4 rungs x RUNG_TIMEOUT + the CPU fallback (~100 min) and an outer
+# harness timeout kills the process unparsed — the exact round-2 failure
+# mode. Rung timeouts shrink to fit the remaining budget instead, always
+# reserving time for the CPU fallback to print a line.
+_DEADLINE = time.monotonic() + float(
+    os.environ.get("HEAT3D_BENCH_DEADLINE", "1500")
+)
+_CPU_FALLBACK_RESERVE = 300.0
+
+
+def _remaining() -> float:
+    return _DEADLINE - time.monotonic()
 
 
 def _probe_with_retry():
@@ -154,6 +170,10 @@ def _measure_in_child(grid_edge=None, cpu=False):
         env["HEAT3D_BENCH_STEPS"] = "10"
         env["HEAT3D_BENCH_TIME_BLOCKING"] = "1"
     timeout = float(os.environ.get("HEAT3D_BENCH_RUNG_TIMEOUT", "1200"))
+    # never let one child run past the shared deadline; TPU rungs also
+    # leave the CPU fallback enough budget to print a line
+    reserve = 0.0 if cpu else _CPU_FALLBACK_RESERVE
+    timeout = max(60.0, min(timeout, _remaining() - reserve))
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
         env=env,
@@ -187,6 +207,12 @@ def main() -> int:
     fallback_reason = None
     last_err = None  # formatted string only — never the exception object
     for rung in rungs:
+        if _remaining() < _CPU_FALLBACK_RESERVE + 60:
+            sys.stderr.write(
+                "bench: deadline nearly exhausted; skipping remaining "
+                "rungs for the CPU fallback\n"
+            )
+            break
         try:
             rec = _measure_in_child(grid_edge=rung)
         except Exception as e:  # noqa: BLE001 - degrade, never die unparsed

@@ -110,6 +110,12 @@ def bench_halo(
     round 2), so ``rtt_dominated`` rows should only appear for
     micro-exchanges on extreme-RTT links.
 
+    Tail honesty: averaging k exchanges per sample necessarily dilutes
+    per-exchange latency spikes, so the tail field is named
+    ``p95_mean_us`` — the 95th percentile of per-PROGRAM means — and must
+    not be read as per-exchange tail latency (which is unobservable
+    through a high-RTT host link; the judged metric is the p50).
+
     On a (1,1,1) mesh no collective executes (size-1 axes short-circuit to
     self-wrap / BC fill): such rows measure the local pad/crop cost only
     and are labeled ``ici: false``.
@@ -183,7 +189,7 @@ def bench_halo(
         "iters": iters,
         "exchanges_per_program": k,
         "p50_us": percentile(times, 50) * 1e6,
-        "p95_us": percentile(times, 95) * 1e6,
+        "p95_mean_us": percentile(times, 95) * 1e6,
         "min_us": min(times) * 1e6,
         "sync_rtt_us": rtt * 1e6,
         "rtt_dominated": rtt_dominated,

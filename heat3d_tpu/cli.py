@@ -67,6 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "A/B knob for whether bf16 throughput is VPU- or "
                    "assembly-bound); residual still accumulates fp32")
     p.add_argument("--backend", choices=["auto", "jnp", "pallas"], default="auto")
+    p.add_argument(
+        "--dump-slice", nargs=3, metavar=("AXIS", "INDEX", "PATH"),
+        default=None,
+        help="after the run, save one global 2D plane as .npy: axis x|y|z "
+        "(or 0|1|2), global index along it, output path — the reference "
+        "class's visualization dump",
+    )
     p.add_argument("--overlap", action="store_true",
                    help="overlap halo exchange with interior compute "
                    "(interior/boundary split step)")
@@ -161,6 +168,33 @@ def _main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     distributed.initialize(args.coordinator, args.num_processes, args.process_id)
     cfg = config_from_args(args)
+
+    dump_slice = None
+    if args.dump_slice:
+        # validate BEFORE the run so a bad flag fails in ms, not hours
+        axis_s, index_s, dump_path = args.dump_slice
+        axis = {"x": 0, "y": 1, "z": 2}.get(axis_s.lower())
+        if axis is None:
+            try:
+                axis = int(axis_s)
+            except ValueError:
+                raise ValueError(
+                    f"--dump-slice axis must be x|y|z or 0|1|2, got {axis_s!r}"
+                ) from None
+        if not 0 <= axis <= 2:
+            raise ValueError(f"--dump-slice axis must be 0..2, got {axis}")
+        try:
+            index = int(index_s)
+        except ValueError:
+            raise ValueError(
+                f"--dump-slice index must be an int, got {index_s!r}"
+            ) from None
+        if not 0 <= index < cfg.grid.shape[axis]:
+            raise ValueError(
+                f"--dump-slice index {index} outside grid extent "
+                f"{cfg.grid.shape[axis]} on axis {axis}"
+            )
+        dump_slice = (axis, index, dump_path)
 
     from heat3d_tpu.models.heat3d import HeatSolver3D
 
@@ -267,6 +301,17 @@ def _main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint:
         solver.save_checkpoint(args.checkpoint, u, steps_done)
 
+    slice_path = None
+    if dump_slice is not None:
+        axis, index, slice_path = dump_slice
+        plane = solver.gather_slice(u, axis, index)  # all processes join
+        if distributed.is_coordinator():
+            np.save(slice_path, plane)
+            log.info(
+                "dumped slice axis=%d index=%d shape=%s -> %s",
+                axis, index, plane.shape, slice_path,
+            )
+
     cells = cfg.grid.num_cells
     updates = cells * max(steps_done - start_step, 1)
     n_dev = cfg.mesh.num_devices
@@ -282,6 +327,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
         "gcell_updates_per_sec": updates / elapsed / 1e9,
         "gcell_updates_per_sec_per_chip": updates / elapsed / 1e9 / n_dev,
     }
+    if slice_path is not None:
+        summary["slice_path"] = slice_path
 
     if args.golden_check:
         from heat3d_tpu.core import golden

@@ -229,6 +229,59 @@ class HeatSolver3D:
             full = full[tuple(slice(0, g) for g in self.cfg.grid.shape)]
         return full
 
+    def gather_slice(self, u: jax.Array, axis: int, index: int) -> np.ndarray:
+        """One global 2D plane of the field on the host — the reference
+        class's visualization dump (SURVEY.md §4: correctness by "visual/
+        numeric inspection of dumped slices") without materializing the
+        full global array anywhere. ``index`` is a GLOBAL coordinate along
+        ``axis``. Multi-host safe: the replicated out_sharding makes XLA
+        gather just this plane to every process, so all processes must
+        call it (like :meth:`gather`)."""
+        g = self.cfg.grid.shape
+        if not 0 <= axis <= 2:
+            raise ValueError(f"slice axis must be 0..2, got {axis}")
+        if not 0 <= index < g[axis]:
+            raise ValueError(
+                f"slice index {index} outside grid extent {g[axis]} on "
+                f"axis {axis}"
+            )
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # XLA's sharding propagation cannot slice a sharded dim to size 1
+        # (ShardingTypeError), so extract per-shard: the one device row
+        # holding the plane contributes it, everyone else zeros, and a psum
+        # along the slice axis broadcasts it — traffic is one plane, never
+        # the volume.
+        names = self.cfg.mesh.axis_names
+        axis_name = names[axis]
+
+        def local_plane(x):
+            i = lax.axis_index(axis_name)
+            nloc = x.shape[axis]
+            li = index - i * nloc  # storage coords == physical coords
+            ok = jnp.logical_and(li >= 0, li < nloc)
+            piece = lax.dynamic_index_in_dim(
+                x, jnp.clip(li, 0, nloc - 1), axis, keepdims=False
+            )
+            piece = jnp.where(ok, piece, jnp.zeros_like(piece))
+            return lax.psum(piece, axis_name)
+
+        out_names = tuple(n for a, n in enumerate(names) if a != axis)
+        plane = jax.jit(
+            jax.shard_map(
+                local_plane,
+                mesh=self.mesh,
+                in_specs=PartitionSpec(*names),
+                out_specs=PartitionSpec(*out_names),
+                check_vma=False,
+            ),
+            out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+        )(u)
+        keep = [s for a, s in enumerate(g) if a != axis]
+        # strip any uneven-decomposition storage padding from the plane
+        return np.asarray(plane)[: keep[0], : keep[1]]
+
     def save_checkpoint(self, path: str, u: jax.Array, step: int) -> None:
         ckpt.save(path, u, step, extra={"config": repr(self.cfg)})
 

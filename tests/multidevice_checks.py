@@ -488,6 +488,28 @@ def check_sharded_checkpoint_roundtrip():
     print("sharded_checkpoint_roundtrip OK")
 
 
+def check_gather_slice_distributed():
+    """gather_slice on a real (2,2,2) mesh == the golden field's plane,
+    including an uneven (bc-padded) decomposition whose padding must be
+    stripped from the plane."""
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    for grid in ((8, 8, 8), (10, 9, 8)):
+        cfg = SolverConfig(
+            grid=GridConfig(shape=grid),
+            mesh=MeshConfig(shape=(2, 2, 2)),
+            backend="jnp",
+        )
+        solver = HeatSolver3D(cfg)
+        u = solver.run(solver.init_state("gaussian"), 2)
+        full = solver.gather(u)
+        for axis, index in ((0, 0), (1, grid[1] - 1), (2, grid[2] // 2)):
+            plane = solver.gather_slice(u, axis, index)
+            idx = tuple(index if a == axis else slice(None) for a in range(3))
+            np.testing.assert_array_equal(plane, full[idx])
+    print("gather_slice_distributed OK")
+
+
 def main():
     n = len(jax.devices())
     assert n == 8, f"expected 8 CPU devices, got {n} ({jax.devices()})"
@@ -502,6 +524,7 @@ def main():
     check_multistep_vs_golden()
     check_dma_halo_ring_interpret()
     check_sharded_checkpoint_roundtrip()
+    check_gather_slice_distributed()
     print("ALL MULTIDEVICE CHECKS PASSED")
 
 

@@ -201,6 +201,38 @@ def test_cli_checkpoint_resume(tmp_path, capsys):
     assert summary["steps"] >= 2
 
 
+def test_cli_dump_slice(tmp_path, capsys):
+    """--dump-slice saves one global 2D plane that matches the golden
+    model's plane (the reference class's visualization dump)."""
+    from heat3d_tpu.cli import main
+
+    path = str(tmp_path / "plane.npy")
+    rc = main([
+        "--grid", "16", "--steps", "4", "--backend", "jnp",
+        "--dump-slice", "z", "7", path,
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["slice_path"] == path
+    plane = np.load(path)
+    assert plane.shape == (16, 16)
+    want = golden.run(
+        golden.make_init("hot-cube", (16, 16, 16)),
+        SolverConfig(grid=GridConfig.cube(16)).grid, StencilConfig(), 4,
+    )[:, :, 7]
+    np.testing.assert_allclose(plane.astype(np.float64), want, rtol=1e-5, atol=1e-6)
+
+
+def test_cli_dump_slice_validates_before_run(capsys):
+    from heat3d_tpu.cli import main
+
+    rc = main([
+        "--grid", "16", "--steps", "4", "--backend", "jnp",
+        "--dump-slice", "z", "99", "/tmp/never.npy",
+    ])
+    assert rc == 2
+
+
 def test_cli_profile_dir_emits_trace(tmp_path, capsys):
     """--profile-dir wraps the run in jax.profiler.trace and writes
     TensorBoard/Perfetto artifacts (SURVEY.md §5 'Tracing / profiling')."""
