@@ -190,6 +190,40 @@ def split_y_symmetric(plane_taps):
     return by_dj[-1], by_dj[0]
 
 
+def _factor_y_enabled() -> bool:
+    """HEAT3D_FACTOR_Y knob (default on; '0'/'false' disable) — ONE parser,
+    shared by the emission (accumulate_taps) and the VMEM estimate
+    (effective_num_taps) so the two can never desynchronize."""
+    import os
+
+    return os.environ.get("HEAT3D_FACTOR_Y", "1").lower() not in ("0", "false")
+
+
+def effective_num_taps(taps: np.ndarray) -> int:
+    """Live-temporary count of the chain :func:`accumulate_taps` actually
+    emits under the current factoring knobs: emitted terms plus the cached
+    plane/row sums. The VMEM scoped-stack estimators
+    (ops.stencil_pallas._tap_stack_bytes and the direct kernels' chunk
+    pickers) size the tap chain with this, so the factored 27-point chain
+    (~15 live planes, not 27) qualifies for larger chunks. Reads the same
+    env knobs as the factoring itself (HEAT3D_FACTOR_7PT/HEAT3D_FACTOR_Y),
+    so estimate and emission always agree."""
+    flat = flat_taps(taps)
+    sym = split_x_symmetric(flat)
+    if sym is None:
+        return len(flat)
+    factor_y = _factor_y_enabled()
+    n = 1  # the cached xsum plane
+    for plane in sym:
+        ysym = split_y_symmetric(plane) if factor_y else None
+        if ysym is None:
+            n += len(plane)
+        else:
+            r_taps, m_taps = ysym
+            n += len(r_taps) + len(m_taps) + 1  # + the cached row sum
+    return n
+
+
 def accumulate_taps(taps_flat, term, scalar):
     """THE canonical tap-accumulation order, shared by every compute
     backend (jnp path, streaming/windowed/direct Pallas kernels) so
@@ -208,8 +242,6 @@ def accumulate_taps(taps_flat, term, scalar):
     (same row order); or the plain lexicographic chain when the set
     doesn't factor. ``HEAT3D_FACTOR_Y=0`` disables the y-level factoring
     (on-chip A/B knob, mirroring HEAT3D_FACTOR_7PT at the x level)."""
-    import os
-
     sym = split_x_symmetric(taps_flat)
     if sym is None:
         acc = None
@@ -218,9 +250,7 @@ def accumulate_taps(taps_flat, term, scalar):
             acc = t if acc is None else acc + t
         return acc
 
-    factor_y = os.environ.get("HEAT3D_FACTOR_Y", "1").lower() not in (
-        "0", "false",
-    )
+    factor_y = _factor_y_enabled()
 
     def emit_plane(di, plane_taps, acc):
         ysym = split_y_symmetric(plane_taps) if factor_y else None

@@ -33,7 +33,13 @@ except ImportError:  # pragma: no cover - older/newer pallas layouts
     _Element = None
 
 from heat3d_tpu.core.config import SolverConfig
-from heat3d_tpu.core.stencils import STENCILS, accumulate_taps, flat_taps, nonzero_taps
+from heat3d_tpu.core.stencils import (
+    STENCILS,
+    accumulate_taps,
+    effective_num_taps,
+    flat_taps,
+    nonzero_taps,
+)
 
 # VMEM working-set budget for one grid step, empirically tuned: the
 # pipeline needs two in-flight input windows plus the output tile, and
@@ -106,7 +112,7 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
     if jnp.dtype(cfg.precision.storage).itemsize not in (2, 4):
         return False, f"unsupported storage dtype {cfg.precision.storage}"
     itemsize = jnp.dtype(cfg.precision.storage).itemsize
-    n_taps = STENCILS[cfg.stencil.kind].num_taps
+    n_taps = effective_num_taps(STENCILS[cfg.stencil.kind].weights)
     c_item = jnp.dtype(cfg.precision.compute).itemsize
     import os
 
@@ -541,7 +547,7 @@ def apply_taps_pallas(
     c_item = jnp.dtype(compute_dtype).itemsize
     if stream_supported(
         (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
-        n_taps=len(tap_list), compute_itemsize=c_item,
+        n_taps=effective_num_taps(taps), compute_itemsize=c_item,
     ):
         return apply_taps_pallas_stream(
             up, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
@@ -550,7 +556,7 @@ def apply_taps_pallas(
     compute_dtype = jnp.dtype(compute_dtype).type
     blocks = choose_blocks(
         (nx, ny, nz), up.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
-        n_taps=len(tap_list), compute_itemsize=c_item,
+        n_taps=effective_num_taps(taps), compute_itemsize=c_item,
     )
     if blocks is None:
         raise ValueError(f"no VMEM-feasible tiling for local shape {(nx, ny, nz)}")

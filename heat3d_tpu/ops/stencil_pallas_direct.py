@@ -50,7 +50,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from heat3d_tpu.core.stencils import flat_taps, nonzero_taps
+from heat3d_tpu.core.stencils import effective_num_taps, flat_taps, nonzero_taps
 
 _LANE = 128
 _SUBLANE = 8
@@ -327,7 +327,7 @@ def apply_taps_direct(
     flat = flat_taps(taps)
     by = choose_chunk(
         u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
-        n_taps=len(flat),
+        n_taps=effective_num_taps(taps),
         compute_itemsize=jnp.dtype(compute_dtype).itemsize,
     )
     if by is None:
@@ -511,7 +511,7 @@ def apply_taps_direct2(
     flat = flat_taps(taps)
     by = choose_chunk(
         u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
-        n_taps=len(flat),
+        n_taps=effective_num_taps(taps),
         compute_itemsize=jnp.dtype(compute_dtype).itemsize,
     )
     if by is None:

@@ -25,7 +25,7 @@ from heat3d_tpu.core.config import (
     Precision,
     SolverConfig,
 )
-from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
 from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
 from heat3d_tpu.parallel.halo import exchange_halo
 
@@ -218,7 +218,7 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
     except ImportError:
         return None
     itemsize = jnp.dtype(cfg.precision.storage).itemsize
-    n_taps = STENCILS[cfg.stencil.kind].num_taps
+    n_taps = effective_num_taps(STENCILS[cfg.stencil.kind].weights)
     c_item = jnp.dtype(cfg.precision.compute).itemsize
     if not direct_supported(
         cfg.local_shape, halo, itemsize, itemsize, n_taps, c_item
@@ -616,7 +616,7 @@ def make_superstep_fn(
             )
 
             itemsize = jnp.dtype(cfg.precision.storage).itemsize
-            n_taps = STENCILS[cfg.stencil.kind].num_taps
+            n_taps = effective_num_taps(STENCILS[cfg.stencil.kind].weights)
             c_item = jnp.dtype(cfg.precision.compute).itemsize
             if (
                 jax.devices()[0].platform == "tpu"

@@ -1,0 +1,66 @@
+"""Render a --dump-slice plane (.npy) to a PNG heatmap.
+
+The visualization half of the reference class's workflow (SURVEY.md §4:
+correctness by "visual/numeric inspection of dumped slices"):
+
+    heat3d --grid 256 --steps 500 --dump-slice z 128 plane.npy
+    python scripts/plot_slice.py plane.npy plane.png
+
+Encoding choices (magnitude of a continuous scalar field): a single
+perceptually-uniform sequential colormap — ``cividis``, designed for
+color-vision-deficient readers; never a rainbow — with a labeled colorbar
+as the legend and neutral-ink annotations.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(
+            "usage: plot_slice.py plane.npy [out.png] [title]", file=sys.stderr
+        )
+        return 2
+    src = argv[0]
+    out = argv[1] if len(argv) > 1 else os.path.splitext(src)[0] + ".png"
+    title = argv[2] if len(argv) > 2 else os.path.basename(src)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    plane = np.load(src).astype(np.float64)
+    fig, ax = plt.subplots(figsize=(6.4, 5.2), dpi=150)
+    im = ax.imshow(
+        plane.T,  # axis 0 of the plane on x, origin at the domain corner
+        origin="lower",
+        cmap="cividis",
+        interpolation="nearest",
+        aspect="equal",
+    )
+    cbar = fig.colorbar(im, ax=ax, shrink=0.85)
+    cbar.set_label("temperature u", color="#444444")
+    ax.set_title(title, color="#222222")
+    ax.set_xlabel("first in-plane axis (cells)", color="#444444")
+    ax.set_ylabel("second in-plane axis (cells)", color="#444444")
+    ax.tick_params(colors="#666666", labelsize=8)
+    for spine in ax.spines.values():
+        spine.set_color("#cccccc")
+    fig.tight_layout()
+    fig.savefig(out)
+    print(
+        f"wrote {out}: {plane.shape[0]}x{plane.shape[1]} plane, "
+        f"u in [{plane.min():.4g}, {plane.max():.4g}]"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

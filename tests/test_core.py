@@ -276,6 +276,20 @@ def test_split_y_symmetric_contract():
     assert split_y_symmetric(broken) is None
 
 
+def test_effective_num_taps_matches_factoring(monkeypatch):
+    """The VMEM-stack estimate tracks the factored chain: 15 live
+    temporaries for the x+y-factored 27pt (12 terms + xsum plane + 2 row
+    caches), 19 with y-factoring off, 7 for the unfactored 7pt."""
+    from heat3d_tpu.core.stencils import effective_num_taps
+
+    monkeypatch.delenv("HEAT3D_FACTOR_7PT", raising=False)
+    monkeypatch.setenv("HEAT3D_FACTOR_Y", "1")
+    assert effective_num_taps(STENCILS["27pt"].weights) == 15
+    assert effective_num_taps(STENCILS["7pt"].weights) == 7
+    monkeypatch.setenv("HEAT3D_FACTOR_Y", "0")
+    assert effective_num_taps(STENCILS["27pt"].weights) == 19
+
+
 def test_accumulate_taps_y_factoring_op_counts(monkeypatch):
     """The factored 27pt chain emits 12 terms (3+3 per plane) with y-
     factoring on, 18 with it off — the measurable op-count contract."""
