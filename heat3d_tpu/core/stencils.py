@@ -199,29 +199,50 @@ def _factor_y_enabled() -> bool:
     return os.environ.get("HEAT3D_FACTOR_Y", "1").lower() not in ("0", "false")
 
 
+class _CountToken:
+    """Absorbing element for the counting pass of effective_num_taps."""
+
+    def __add__(self, other):
+        return self
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        return self
+
+    __rmul__ = __mul__
+
+
 def effective_num_taps(taps: np.ndarray) -> int:
     """Live-temporary count of the chain :func:`accumulate_taps` actually
     emits under the current factoring knobs: emitted terms plus the cached
     plane/row sums. The VMEM scoped-stack estimators
     (ops.stencil_pallas._tap_stack_bytes and the direct kernels' chunk
     pickers) size the tap chain with this, so the factored 27-point chain
-    (~15 live planes, not 27) qualifies for larger chunks. Reads the same
-    env knobs as the factoring itself (HEAT3D_FACTOR_7PT/HEAT3D_FACTOR_Y),
-    so estimate and emission always agree."""
+    (~15 live planes, not 27) qualifies for larger chunks.
+
+    Desync-proof by construction: the count is taken by DRIVING
+    :func:`accumulate_taps` itself with a counting ``term``/``scalar``
+    stub — tallying emitted terms plus the distinct ``xsum``/``ysum``
+    cache keys implementations hold live — so any future change to the
+    emission (new factoring level, different caching) changes this
+    estimate automatically."""
     flat = flat_taps(taps)
-    sym = split_x_symmetric(flat)
-    if sym is None:
-        return len(flat)
-    factor_y = _factor_y_enabled()
-    n = 1  # the cached xsum plane
-    for plane in sym:
-        ysym = split_y_symmetric(plane) if factor_y else None
-        if ysym is None:
-            n += len(plane)
-        else:
-            r_taps, m_taps = ysym
-            n += len(r_taps) + len(m_taps) + 1  # + the cached row sum
-    return n
+    n_terms = 0
+    caches = set()
+    tok = _CountToken()
+
+    def term(di, dj, dk):
+        nonlocal n_terms
+        n_terms += 1
+        if di == "xsum":
+            caches.add("p")
+        if dj == "ysum":
+            caches.add(("ys", di))
+        return tok
+
+    accumulate_taps(flat, term, lambda w: tok)
+    return n_terms + len(caches)
 
 
 def accumulate_taps(taps_flat, term, scalar):

@@ -114,14 +114,33 @@ def _run_pair(args, timeout=300):
 
 def test_two_process_cli_golden_and_checkpoint(tmp_path):
     ck = str(tmp_path / "ck")
+    plane = str(tmp_path / "plane.npy")
     outs = _run_pair(
         ["--grid", "16", "--steps", "5", "--mesh", "2", "2", "2",
-         "--golden-check", "--checkpoint", ck]
+         "--golden-check", "--checkpoint", ck,
+         "--dump-slice", "z", "9", plane]
     )
     # coordinator prints the one JSON summary; the other process stays quiet
     summary = _summary(outs[0][1])
     assert summary["golden_pass"] is True
     assert summary["mesh"] == [2, 2, 2]
+    # the slice dump crossed real process boundaries: only the coordinator
+    # writes it, and its VALUES match the golden model's z=9 plane
+    import numpy as np
+
+    from heat3d_tpu.core import golden
+    from heat3d_tpu.core.config import GridConfig, SolverConfig, StencilConfig
+
+    assert summary["slice_path"] == plane
+    got_plane = np.load(plane)
+    assert got_plane.shape == (16, 16)
+    want = golden.run(
+        golden.make_init("hot-cube", (16, 16, 16)),
+        SolverConfig(grid=GridConfig.cube(16)).grid, StencilConfig(), 5,
+    )[:, :, 9]
+    np.testing.assert_allclose(
+        got_plane.astype(np.float64), want, rtol=1e-5, atol=1e-6
+    )
     # non-coordinator emits no JSON summary (Gloo may chat on stdout)
     assert not [
         ln for ln in outs[1][1].splitlines() if ln.startswith("{")
