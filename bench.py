@@ -71,12 +71,22 @@ def _probe_with_retry():
     Defaults (3 x 60 s probes + 2 x 15 s backoff = 210 s worst case) are
     sized so probing plus one measurement rung finishes — and prints the
     JSON line — inside typical outer harness timeouts."""
-    from heat3d_tpu.utils.backendprobe import probe_platform
+    from heat3d_tpu.utils.backendprobe import probe_platform, probe_timeout
 
     attempts = int(os.environ.get("HEAT3D_BENCH_PROBE_ATTEMPTS", "3"))
     backoff = float(os.environ.get("HEAT3D_BENCH_PROBE_BACKOFF", "15"))
     for i in range(attempts):
-        platform = probe_platform()
+        # probes shrink to the shared deadline like rung timeouts do: a
+        # tight HEAT3D_BENCH_DEADLINE must not be eaten by probing before
+        # the CPU fallback has budget to print the line
+        budget = _remaining() - _CPU_FALLBACK_RESERVE
+        if budget < 30:
+            sys.stderr.write(
+                "bench: deadline nearly exhausted during probing; "
+                "stopping probes for the CPU fallback\n"
+            )
+            return None
+        platform = probe_platform(timeout=min(probe_timeout(), budget))
         if platform is not None:
             return platform
         sys.stderr.write(

@@ -100,11 +100,13 @@ def bench_halo(
     Methodology (the same trick ``bench_throughput`` uses): a DEVICE-SIDE
     ``fori_loop`` of ``k`` back-to-back exchanges is compiled as one XLA
     program, the whole program is timed with one sync, and the per-exchange
-    latency is (wall - rtt) / k. The loop carry is the mean of the lower-
-    and upper-corner crops of the exchanged block — the low crop reads the
-    received low-side ghosts, the high crop the high-side ghosts, so ALL
-    six ppermutes are data-live every iteration and XLA cannot DCE any of
-    them — while the carry shape stays fixed. ``k`` is
+    latency is (wall - rtt) / k. The loop carry is the local block with
+    each of its six boundary faces overwritten by the received ghost face
+    on that side — ALL six ppermutes are data-live every iteration so XLA
+    cannot DCE any of them, the carry shape stays fixed, and the
+    non-exchange work charged per iteration is six FACE-sized in-place
+    updates, not a volume reduction (which would inflate the judged p50
+    by a volume's worth of HBM traffic). ``k`` is
     auto-scaled until device time swamps the host round trip (the ~75 ms
     axon-tunnel RTT that made every host-dispatched sample RTT-dominated in
     round 2), so ``rtt_dominated`` rows should only appear for
@@ -127,14 +129,21 @@ def bench_halo(
 
     # exchange routes through the configured transport (ppermute or the
     # Pallas remote-DMA kernels), so the judged halo p50 covers both tiers.
+    nx, ny, nz = local
+
     def _loop(u_local, n):
         def body(_, u):
-            p = exchange(u, cfg)
-            lo = jax.lax.slice(p, (0, 0, 0), local)  # reads lo-side ghosts
-            hi = jax.lax.slice(  # reads hi-side ghosts
-                p, tuple(s - l for s, l in zip(p.shape, local)), p.shape
-            )
-            return 0.5 * (lo + hi)
+            p = exchange(u, cfg)  # (nx+2, ny+2, nz+2), ghosts filled
+            # fold each received ghost face onto the carry's boundary face
+            # (in-place DUS on the loop carry: face-sized writes only)
+            out = u
+            out = out.at[0].set(p[0, 1 : 1 + ny, 1 : 1 + nz])
+            out = out.at[nx - 1].set(p[nx + 1, 1 : 1 + ny, 1 : 1 + nz])
+            out = out.at[:, 0].set(p[1 : 1 + nx, 0, 1 : 1 + nz])
+            out = out.at[:, ny - 1].set(p[1 : 1 + nx, ny + 1, 1 : 1 + nz])
+            out = out.at[:, :, 0].set(p[1 : 1 + nx, 1 : 1 + ny, 0])
+            out = out.at[:, :, nz - 1].set(p[1 : 1 + nx, 1 : 1 + ny, nz + 1])
+            return out
 
         return jax.lax.fori_loop(0, n, body, u_local)
 

@@ -70,6 +70,10 @@ def main() -> int:
         grid = r["grid"][0] if len(set(r["grid"])) == 1 else "x".join(
             map(str, r["grid"]))
         flag = " (RTT!)" if r.get("rtt_dominated") else ""
+        # compute dtype doesn't change HBM traffic (storage dtype does),
+        # but label it so bf16-compute A/B rows are tellable apart
+        if r.get("compute_dtype", "float32") != "float32":
+            flag = " (c=bf16)" + flag
         print(f"{grid:>6} {r['dtype']:>8} {r.get('time_blocking', 1):>2} "
               f"{path:>16} {per_update:>10.1f} {ceiling:>9.1f} "
               f"{meas:>9.2f} {meas / ceiling:>7.1%}{flag}")

@@ -112,6 +112,39 @@ def test_root_bench_emits_one_json_line():
     assert set(d) >= {"metric", "value", "unit", "vs_baseline"}
 
 
+@pytest.mark.slow
+def test_root_bench_ladder_exhaustion_falls_back_to_cpu():
+    """Every measurement rung failing (here: an impossible time-blocking
+    factor) must walk the ladder, then emit a MEASURED CPU-fallback line
+    tagged with the failure — never a traceback (the resilience
+    contract)."""
+    import os
+
+    env = {
+        **os.environ,
+        "HEAT3D_BENCH_GRID": "16",
+        "HEAT3D_BENCH_STEPS": "2",
+        # local extents can never satisfy this blocking factor, so every
+        # rung child fails; the CPU fallback forces tb=1 and succeeds
+        "HEAT3D_BENCH_TIME_BLOCKING": "99",
+        "HEAT3D_BENCH_DEADLINE": "400",
+        "HEAT3D_BENCH_PROBE_ATTEMPTS": "1",
+    }
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["error"].startswith("all_rungs_failed")
+    assert d["detail"]["cpu_fallback"] is True
+    assert d["value"] > 0  # a real CPU measurement, not a zero placeholder
+
+
 def test_scaling_rows_weak_and_strong():
     from heat3d_tpu.bench.report import render, scaling_rows
 
