@@ -35,7 +35,7 @@ from heat3d_tpu.core.config import (
 from heat3d_tpu.core.stencils import STENCILS, Stencil, stencil_taps
 from heat3d_tpu.models.heat3d import HeatSolver3D
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "BoundaryCondition",
