@@ -11,7 +11,9 @@ cd "$(dirname "$0")/.."
 
 OUT=${1:-bench_results.jsonl}
 REPORT_MD=${2:-${REPORT_MD:-BASELINE.md}}
-: > "$OUT"
+# APPEND=1 resumes an interrupted measurement session instead of
+# truncating the rows a prior (e.g. tunnel-wedged) run already landed
+[[ -n "${APPEND:-}" ]] || : > "$OUT"
 [[ -f "$REPORT_MD" ]] || : > "$REPORT_MD"
 
 # Single-chip sweep: the judged grid ladder at fp32+bf16, temporal blocking
@@ -37,8 +39,11 @@ for stencil in ${STENCILS:-7pt 27pt}; do
         # pass), throughput-only otherwise — no duplicate halo rows
         bench=throughput
         [[ $stencil == 7pt && $tb == 1 ]] && bench=all
-        # a failing row (e.g. 1024^3 OOM on a small-HBM chip) skips, not aborts
-        python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
+        # a failing row (e.g. 1024^3 OOM on a small-HBM chip) skips, not
+        # aborts; ROW_TIMEOUT bounds a row that hangs on a wedged tunnel
+        # (one stuck 1024^3 transfer must cost one row, not the stage)
+        timeout "${ROW_TIMEOUT:-900}" \
+          python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
           --stencil "$stencil" --dtype "$dtype" --time-blocking "$tb" \
           --mesh 1 1 1 --bench "$bench" \
           >> "$OUT" 2>/dev/null \
@@ -55,7 +60,8 @@ done
 if [[ -z "${SKIP_BF16_COMPUTE:-}" ]]; then
   for grid in ${GRIDS:-512 1024}; do
     [[ $grid -lt 512 ]] && continue
-    python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
+    timeout "${ROW_TIMEOUT:-900}" \
+      python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
       --dtype bf16 --compute-dtype bf16 --time-blocking 2 --mesh 1 1 1 \
       --bench throughput >> "$OUT" 2>/dev/null \
       || echo "suite: skipped bf16-compute grid=$grid (rc=$?)" >&2
@@ -63,7 +69,8 @@ if [[ -z "${SKIP_BF16_COMPUTE:-}" ]]; then
 fi
 
 if [[ -z "${SKIP_OVERLAP:-}" ]]; then
-  python -m heat3d_tpu.bench --grid "${OVERLAP_GRID:-512}" \
+  timeout "${ROW_TIMEOUT:-900}" \
+    python -m heat3d_tpu.bench --grid "${OVERLAP_GRID:-512}" \
     --steps "${STEPS:-50}" --overlap --mesh 1 1 1 --bench throughput \
     >> "$OUT" 2>/dev/null \
     || echo "suite: skipped overlap run (rc=$?)" >&2
