@@ -31,6 +31,15 @@ from heat3d_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def _device_init_enabled() -> bool:
+    import os
+
+    return os.environ.get("HEAT3D_DEVICE_INIT", "1").lower() not in (
+        "0",
+        "false",
+    )
+
+
 def _select_backend(cfg: SolverConfig):
     """Resolve the compute backend to a padded-block compute callable.
 
@@ -110,6 +119,7 @@ class HeatSolver3D:
         # constraints (halo transport, local extents) that convergence-mode
         # runs never exercise.
         self._multistep_cache = None
+        self._device_field_cache = {}
         self._converge = jax.jit(
             make_converge_fn(cfg, self.mesh, compute), donate_argnums=0
         )
@@ -132,8 +142,18 @@ class HeatSolver3D:
     def init_state(self, init: Union[str, np.ndarray] = "hot-cube") -> jax.Array:
         """Build the sharded initial field. A string selects a named
         initializer (core.golden.INITIALIZERS); an array is used directly.
-        Materialization is per-shard via make_array_from_callback, so no
-        process ever holds the full 4096^3 field (SURVEY.md §2 C8).
+
+        Initializers whose values are exactly representable constants
+        (``hot-cube``) are built ON DEVICE — a jitted elementwise iota
+        program under ``out_shardings``, so no host buffer is materialized
+        and no bulk host->device transfer happens (at 1024^3 the host path
+        ships 4 GiB through the link before the first step can run; the
+        device path ships nothing, and GSPMD partitions the iota masks with
+        zero communication). The result is bitwise-identical to the host
+        path; ``HEAT3D_DEVICE_INIT=0`` forces the host path for A/B.
+        Everything else (value-generating initializers, explicit arrays)
+        materializes per-shard via make_array_from_callback, so no process
+        ever holds the full 4096^3 field either way (SURVEY.md §2 C8).
 
         Storage is ``cfg.padded_shape``; for uneven decompositions the
         region beyond ``cfg.grid.shape`` is pinned at bc_value (see
@@ -146,12 +166,50 @@ class HeatSolver3D:
             return self._sharded_from_blocks(
                 lambda clipped: arr[clipped]
             )
+        if init == "hot-cube" and _device_init_enabled():
+            return self._device_field(hot_cube=True)
         name, seed = init, self.cfg.run.seed
         return self._sharded_from_blocks(
             lambda clipped: golden.make_init_block(
                 name, true_shape, clipped, seed=seed
             ).astype(self.storage_dtype)
         )
+
+    def _device_field(self, hot_cube: bool) -> jax.Array:
+        """All-zero (or hot-cube) TRUE grid in storage layout, built on
+        device: elementwise over coordinate iotas, jitted with
+        ``out_shardings``, bitwise-equal to the host block path (the only
+        values are 0, 1, and bc_value — exactly representable in every
+        storage dtype)."""
+        jitted = self._device_field_cache.get(hot_cube)
+        if jitted is not None:
+            return jitted()
+        storage = self.cfg.padded_shape
+        true_shape = self.cfg.grid.shape
+        bc_value = self.cfg.stencil.bc_value
+        dtype = self.storage_dtype
+
+        def build():
+            in_true = None
+            in_cube = None
+            for ax, nt in enumerate(true_shape):
+                io = jax.lax.broadcasted_iota(jnp.int32, storage, ax)
+                t = io < nt
+                in_true = t if in_true is None else in_true & t
+                if hot_cube:
+                    # same bounds arithmetic as golden.make_init_block
+                    g0 = int(nt * (0.5 - 0.25 / 2))
+                    g1 = max(int(nt * (0.5 + 0.25 / 2)), g0 + 1)
+                    c = (io >= g0) & (io < g1)
+                    in_cube = c if in_cube is None else in_cube & c
+            val = jnp.zeros(storage, dtype)
+            if hot_cube:
+                val = jnp.where(in_cube, jnp.ones((), dtype), val)
+            return jnp.where(in_true, val, jnp.full((), bc_value, dtype))
+
+        jitted = jax.jit(build, out_shardings=self.sharding)
+        self._device_field_cache[hot_cube] = jitted
+        return jitted()
 
     def _sharded_from_blocks(self, true_block_fn) -> jax.Array:
         """Build a sharded storage-layout field from a function evaluating
@@ -186,7 +244,10 @@ class HeatSolver3D:
 
     def zeros_state(self) -> jax.Array:
         """An all-zero TRUE grid in storage layout (padding at bc_value) —
-        cheap warmup input for the donated executables."""
+        cheap warmup input for the donated executables. Built on device
+        (no host buffer, no transfer) unless HEAT3D_DEVICE_INIT=0."""
+        if _device_init_enabled():
+            return self._device_field(hot_cube=False)
         return self._sharded_from_blocks(
             lambda clipped: np.zeros(
                 tuple(c.stop - c.start for c in clipped), self.storage_dtype

@@ -12,8 +12,38 @@ cd "$(dirname "$0")/.."
 OUT=${1:-bench_results.jsonl}
 REPORT_MD=${2:-${REPORT_MD:-BASELINE.md}}
 # APPEND=1 resumes an interrupted measurement session instead of
-# truncating the rows a prior (e.g. tunnel-wedged) run already landed
+# truncating the rows a prior (e.g. tunnel-wedged) run already landed;
+# configs already recorded in $OUT are skipped, not re-run (no duplicate
+# table rows, no re-spending the session budget on finished rows)
 [[ -n "${APPEND:-}" ]] || : > "$OUT"
+
+# has_row STENCIL GRID DTYPE TB COMPUTE OVERLAP -> 0 if $OUT already has a
+# matching throughput row (only consulted in APPEND mode)
+has_row() {
+  [[ -n "${APPEND:-}" && -s "$OUT" ]] || return 1
+  python - "$OUT" "$@" <<'EOF'
+import json, sys
+out, stencil, grid, dtype, tb, compute, overlap = sys.argv[1:8]
+want_dtype = {"fp32": "float32", "bf16": "bfloat16"}[dtype]
+want_compute = {"fp32": "float32", "bf16": "bfloat16"}[compute]
+for line in open(out):
+    try:
+        r = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if (
+        r.get("bench") == "throughput"
+        and r.get("stencil") == stencil
+        and r.get("grid") == [int(grid)] * 3
+        and r.get("dtype") == want_dtype
+        and r.get("compute_dtype", "float32") == want_compute
+        and r.get("time_blocking", 1) == int(tb)
+        and bool(r.get("overlap")) == (overlap == "1")
+    ):
+        sys.exit(0)
+sys.exit(1)
+EOF
+}
 [[ -f "$REPORT_MD" ]] || : > "$REPORT_MD"
 
 # Single-chip sweep: the judged grid ladder at fp32+bf16, temporal blocking
@@ -39,6 +69,10 @@ for stencil in ${STENCILS:-7pt 27pt}; do
         # pass), throughput-only otherwise — no duplicate halo rows
         bench=throughput
         [[ $stencil == 7pt && $tb == 1 ]] && bench=all
+        if has_row "$stencil" "$grid" "$dtype" "$tb" fp32 0; then
+          echo "suite: already recorded $stencil grid=$grid dtype=$dtype tb=$tb" >&2
+          continue
+        fi
         # a failing row (e.g. 1024^3 OOM on a small-HBM chip) skips, not
         # aborts; ROW_TIMEOUT bounds a row that hangs on a wedged tunnel
         # (one stuck 1024^3 transfer must cost one row, not the stage)
@@ -60,6 +94,10 @@ done
 if [[ -z "${SKIP_BF16_COMPUTE:-}" ]]; then
   for grid in ${GRIDS:-512 1024}; do
     [[ $grid -lt 512 ]] && continue
+    if has_row 7pt "$grid" bf16 2 bf16 0; then
+      echo "suite: already recorded bf16-compute grid=$grid" >&2
+      continue
+    fi
     timeout "${ROW_TIMEOUT:-900}" \
       python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
       --dtype bf16 --compute-dtype bf16 --time-blocking 2 --mesh 1 1 1 \
@@ -69,11 +107,15 @@ if [[ -z "${SKIP_BF16_COMPUTE:-}" ]]; then
 fi
 
 if [[ -z "${SKIP_OVERLAP:-}" ]]; then
-  timeout "${ROW_TIMEOUT:-900}" \
-    python -m heat3d_tpu.bench --grid "${OVERLAP_GRID:-512}" \
-    --steps "${STEPS:-50}" --overlap --mesh 1 1 1 --bench throughput \
-    >> "$OUT" 2>/dev/null \
-    || echo "suite: skipped overlap run (rc=$?)" >&2
+  if has_row 7pt "${OVERLAP_GRID:-512}" fp32 1 fp32 1; then
+    echo "suite: already recorded overlap run" >&2
+  else
+    timeout "${ROW_TIMEOUT:-900}" \
+      python -m heat3d_tpu.bench --grid "${OVERLAP_GRID:-512}" \
+      --steps "${STEPS:-50}" --overlap --mesh 1 1 1 --bench throughput \
+      >> "$OUT" 2>/dev/null \
+      || echo "suite: skipped overlap run (rc=$?)" >&2
+  fi
 fi
 
 python -m heat3d_tpu.bench.report "$OUT" "$REPORT_MD"

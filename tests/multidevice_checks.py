@@ -262,6 +262,59 @@ def check_uneven_decomposition():
     print("uneven_decomposition OK")
 
 
+def check_device_init_distributed():
+    """The on-device hot-cube/zeros builders (models.heat3d._device_field)
+    == the host block path, bitwise, on real multi-device meshes including
+    uneven decompositions (storage padding pinned at bc_value lives on
+    shards the device path must also pin)."""
+    import os
+
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    for grid, mesh_shape in [
+        ((16, 16, 16), (2, 2, 2)),
+        ((9, 10, 11), (2, 2, 2)),    # uneven: padding on every axis
+        ((10, 16, 16), (8, 1, 1)),   # padding thicker than some blocks
+    ]:
+        for prec, bc_value in [
+            (Precision.fp32(), 0.0),
+            (Precision.bf16(), 1.5),
+        ]:
+            cfg = SolverConfig(
+                grid=GridConfig(shape=grid),
+                stencil=StencilConfig(
+                    kind="7pt", bc=BoundaryCondition.DIRICHLET,
+                    bc_value=bc_value,
+                ),
+                mesh=MeshConfig(shape=mesh_shape),
+                precision=prec,
+                backend="jnp",
+            )
+            solver = HeatSolver3D(cfg)
+            prior = os.environ.get("HEAT3D_DEVICE_INIT")
+            os.environ["HEAT3D_DEVICE_INIT"] = "0"
+            try:
+                host_hot = np.asarray(solver.init_state("hot-cube"))
+                host_zero = np.asarray(solver.zeros_state())
+                os.environ["HEAT3D_DEVICE_INIT"] = "1"
+                dev_hot = np.asarray(solver.init_state("hot-cube"))
+                dev_zero = np.asarray(solver.zeros_state())
+            finally:
+                if prior is None:
+                    os.environ.pop("HEAT3D_DEVICE_INIT", None)
+                else:
+                    os.environ["HEAT3D_DEVICE_INIT"] = prior
+            np.testing.assert_array_equal(
+                dev_hot, host_hot,
+                err_msg=f"hot-cube grid={grid} mesh={mesh_shape}",
+            )
+            np.testing.assert_array_equal(
+                dev_zero, host_zero,
+                err_msg=f"zeros grid={grid} mesh={mesh_shape}",
+            )
+    print("device_init_distributed OK")
+
+
 def check_time_blocking_distributed():
     """Temporally-blocked supersteps == plain steps on real multi-device
     meshes, including uneven decompositions (where the intermediate's
@@ -518,6 +571,7 @@ def main():
     check_faces_direct_superstep_distributed()
     check_overlap_step_distributed()
     check_uneven_decomposition()
+    check_device_init_distributed()
     check_time_blocking_distributed()
     check_bf16_distributed()
     check_halo_ghost_identity()
