@@ -161,7 +161,7 @@ def _child_main() -> int:
     )
 
 
-def _measure_in_child(grid_edge=None, cpu=False):
+def _measure_in_child(grid_edge=None, cpu=False, last_rung=False):
     """Run one measurement rung in a killable child; return its JSON record.
 
     Raises on child failure, hang (timeout), or unparseable output."""
@@ -181,9 +181,17 @@ def _measure_in_child(grid_edge=None, cpu=False):
         env["HEAT3D_BENCH_TIME_BLOCKING"] = "1"
     timeout = float(os.environ.get("HEAT3D_BENCH_RUNG_TIMEOUT", "1200"))
     # never let one child run past the shared deadline; TPU rungs also
-    # leave the CPU fallback enough budget to print a line
+    # leave the CPU fallback enough budget to print a line, AND — while
+    # lower rungs remain — take at most half the remaining above-reserve
+    # budget, so a rung that hangs (a wedged-tunnel 1024^3 costs its whole
+    # timeout) still leaves the lower rungs TPU time before the CPU
+    # fallback. The LAST rung has nothing below it to protect and gets the
+    # full remainder.
     reserve = 0.0 if cpu else _CPU_FALLBACK_RESERVE
-    timeout = max(60.0, min(timeout, _remaining() - reserve))
+    budget = _remaining() - reserve
+    if not cpu and not last_rung:
+        budget *= 0.5
+    timeout = max(60.0, min(timeout, budget))
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__)],
         env=env,
@@ -224,7 +232,7 @@ def main() -> int:
             )
             break
         try:
-            rec = _measure_in_child(grid_edge=rung)
+            rec = _measure_in_child(grid_edge=rung, last_rung=rung == rungs[-1])
         except Exception as e:  # noqa: BLE001 - degrade, never die unparsed
             last_err = f"{type(e).__name__}: {str(e)[:200]}"
             del e

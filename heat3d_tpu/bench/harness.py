@@ -23,7 +23,13 @@ from heat3d_tpu.core.config import SolverConfig
 from heat3d_tpu.models.heat3d import HeatSolver3D
 from heat3d_tpu.parallel.step import exchange
 from heat3d_tpu.parallel.topology import build_mesh, field_sharding
-from heat3d_tpu.utils.timing import force_sync, percentile, sync_overhead
+from heat3d_tpu.utils.timing import (
+    calibrate_trip_count,
+    force_sync,
+    honest_time,
+    percentile,
+    sync_overhead,
+)
 
 
 def bench_throughput(
@@ -34,12 +40,16 @@ def bench_throughput(
 ) -> Dict:
     """Gcell-updates/sec (total and per chip) of the compiled time loop.
 
-    ``repeats`` timed runs of a ``steps``-iteration device-side loop; the
-    best run is reported (matching how the reference class reports its
-    timing: minimum over repetitions cancels host jitter)."""
+    ``repeats`` timed runs of a device-side loop; the best run is reported
+    (matching how the reference class reports its timing: minimum over
+    repetitions cancels host jitter). ``steps`` is a floor: the step count
+    is auto-calibrated UP until the program's device time swamps the host
+    round trip (the multistep executable takes the trip count dynamically,
+    so calibration costs no recompiles) — without this, small grids finish
+    in single-digit ms under a ~75 ms tunnel RTT and every row is
+    RTT-dominated no matter how the arithmetic subtracts it."""
     solver = HeatSolver3D(cfg)
     u = solver.init_state("hot-cube")
-    n = jnp.int32(steps)
 
     # The multistep executable donates its input, so thread the field through
     # successive calls (physically: the run just keeps time-stepping).
@@ -48,21 +58,21 @@ def bench_throughput(
     import time as _time
 
     for _ in range(warmup):
-        u = solver.run(u, n)
+        u = solver.run(u, jnp.int32(steps))
         force_sync(u)
     rtt = sync_overhead(probe=jnp.zeros((8, 128)))
-    times = []
-    raw_times = []
-    for _ in range(repeats):
+
+    def _timed(n):
+        nonlocal u
         t0 = _time.perf_counter()
-        u = solver.run(u, n)
+        u = solver.run(u, jnp.int32(n))
         force_sync(u)
-        raw = _time.perf_counter() - t0
-        raw_times.append(raw)
-        # never let RTT subtraction remove >95% of a sample: a measurement
-        # that small is RTT-dominated and flagged invalid below, not
-        # fabricated into an absurd throughput
-        times.append(max(raw - rtt, 0.05 * raw))
+        return _time.perf_counter() - t0
+
+    steps_requested = steps
+    steps, raw = calibrate_trip_count(_timed, rtt, start=steps)
+    raw_times = [raw] + [_timed(steps) for _ in range(repeats - 1)]
+    times = [honest_time(t, rtt) for t in raw_times]
     best = min(times)
     rtt_dominated = min(raw_times) < 2 * rtt
     updates = cfg.grid.num_cells * steps
@@ -79,6 +89,7 @@ def bench_throughput(
         "overlap": cfg.overlap,
         "halo": cfg.halo,
         "steps": steps,
+        "steps_requested": steps_requested,
         "seconds_best": best,
         "seconds_all": times,
         "sync_rtt": rtt,
@@ -171,18 +182,9 @@ def bench_halo(
         return _time.perf_counter() - t0
 
     if k is None:
-        # calibrate: grow k until the compiled program's device time is
-        # >= ~6x the host RTT (one compile thanks to the dynamic trip count)
-        k, k_max = 25, 20000
-        while True:
-            raw = _timed(k)
-            if raw >= 6 * rtt or k >= k_max:
-                break
-            per = max((raw - rtt) / k, 1e-7)
-            k = min(k_max, max(2 * k, int(6.5 * rtt / per)))
+        k, _ = calibrate_trip_count(_timed, rtt, start=25)
     raws = [_timed(k) for _ in range(iters)]
-    # honesty guard: never let RTT subtraction remove >95% of a sample
-    times = [max(t - rtt, 0.05 * t) / k for t in raws]
+    times = [honest_time(t, rtt) / k for t in raws]
     rtt_dominated = min(raws) < 2 * rtt
     face_cells = (
         cfg.local_shape[1] * cfg.local_shape[2]

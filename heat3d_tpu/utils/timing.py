@@ -79,6 +79,34 @@ def time_fn_batched(
     return times
 
 
+def honest_time(raw: float, rtt: float) -> float:
+    """Subtract the measured host round trip from a wall sample, but never
+    remove >95% of it: a sample that small is RTT-dominated and must be
+    flagged invalid by the caller, not fabricated into an absurd rate."""
+    return max(raw - rtt, 0.05 * raw)
+
+
+def calibrate_trip_count(
+    timed, rtt: float, start: int, cap: int = 20000
+) -> tuple:
+    """Grow a compiled loop's trip count until its wall time swamps the
+    host RTT (>= 6x), so per-trip latencies are device time, not dispatch.
+
+    ``timed(n)`` runs the n-trip program and returns its wall seconds; the
+    trip count must be a dynamic argument of the compiled program (both
+    bench_throughput's multistep and bench_halo's exchange loop take it as
+    an operand), so calibration costs no recompiles. Returns
+    ``(n, last_raw)`` — the calibrated count and its measured wall time,
+    which the caller should reuse as its first sample."""
+    n = start
+    while True:
+        raw = timed(n)
+        if raw >= 6 * rtt or n >= cap:
+            return n, raw
+        per = max((raw - rtt) / n, 1e-7)
+        n = min(cap, max(2 * n, int(6.5 * rtt / per)))
+
+
 def percentile(values: List[float], q: float) -> float:
     """Nearest-rank percentile without numpy (tiny lists)."""
     if not values:

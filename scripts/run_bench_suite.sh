@@ -17,6 +17,31 @@ REPORT_MD=${2:-${REPORT_MD:-BASELINE.md}}
 # table rows, no re-spending the session budget on finished rows)
 [[ -n "${APPEND:-}" ]] || : > "$OUT"
 
+# has_halo GRID DTYPE -> 0 if $OUT already has the halo row for this
+# exchange shape (only consulted in APPEND mode). Checked separately from
+# has_row because a bench=all rung killed between its two output lines
+# leaves the throughput row without its paired halo row.
+has_halo() {
+  [[ -n "${APPEND:-}" && -s "$OUT" ]] || return 1
+  python - "$OUT" "$@" <<'EOF'
+import json, sys
+out, grid, dtype = sys.argv[1:4]
+want_dtype = {"fp32": "float32", "bf16": "bfloat16"}[dtype]
+for line in open(out):
+    try:
+        r = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if (
+        r.get("bench") == "halo"
+        and r.get("grid") == [int(grid)] * 3
+        and r.get("dtype") == want_dtype
+    ):
+        sys.exit(0)
+sys.exit(1)
+EOF
+}
+
 # has_row STENCIL GRID DTYPE TB COMPUTE OVERLAP -> 0 if $OUT already has a
 # matching throughput row (only consulted in APPEND mode)
 has_row() {
@@ -70,7 +95,18 @@ for stencil in ${STENCILS:-7pt 27pt}; do
         bench=throughput
         [[ $stencil == 7pt && $tb == 1 ]] && bench=all
         if has_row "$stencil" "$grid" "$dtype" "$tb" fp32 0; then
-          echo "suite: already recorded $stencil grid=$grid dtype=$dtype tb=$tb" >&2
+          if [[ $bench == all ]] && ! has_halo "$grid" "$dtype"; then
+            # resume edge: the prior run died between the throughput line
+            # and the halo line — fill in just the missing halo row
+            echo "suite: backfilling halo row grid=$grid dtype=$dtype" >&2
+            timeout "${ROW_TIMEOUT:-900}" \
+              python -m heat3d_tpu.bench --grid "$grid" \
+              --steps "${STEPS:-50}" --dtype "$dtype" --mesh 1 1 1 \
+              --bench halo >> "$OUT" 2>/dev/null \
+              || echo "suite: halo backfill failed grid=$grid (rc=$?)" >&2
+          else
+            echo "suite: already recorded $stencil grid=$grid dtype=$dtype tb=$tb" >&2
+          fi
           continue
         fi
         # a failing row (e.g. 1024^3 OOM on a small-HBM chip) skips, not
