@@ -150,7 +150,10 @@ def _child_main() -> int:
             "vs_baseline": round(gcells / A100_BASELINE_GCELLS_PER_CHIP, 4),
             "detail": {
                 "grid": edge,
-                "steps": steps,
+                # the CALIBRATED step count (bench_throughput grows the
+                # device-side loop past the host RTT), not the requested one
+                "steps": r["steps"],
+                "steps_requested": r.get("steps_requested", steps),
                 "dtype": dtype,
                 "backend": backend,
                 "time_blocking": time_blocking,

@@ -74,6 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(or 0|1|2), global index along it, output path — the reference "
         "class's visualization dump",
     )
+    p.add_argument(
+        "--dump-vtk", default=None, metavar="PATH",
+        help="after the run, write the final field as legacy binary VTK "
+        "STRUCTURED_POINTS (ParaView/VisIt — the reference class's "
+        "visualization dump). Gathers the full field to the coordinator: "
+        "meant for inspection-sized grids; use --dump-slice for planes of "
+        "pod-scale fields",
+    )
     p.add_argument("--overlap", action="store_true",
                    help="overlap halo exchange with interior compute "
                    "(interior/boundary split step)")
@@ -196,6 +204,15 @@ def _main(argv: Optional[List[str]] = None) -> int:
             )
         dump_slice = (axis, index, dump_path)
 
+    if args.dump_vtk and distributed.is_coordinator():
+        # validate writability BEFORE the run (same rule as --dump-slice):
+        # a bad path must fail in ms, not after hours + a pod-wide gather
+        try:
+            with open(args.dump_vtk, "ab"):
+                pass
+        except OSError as e:
+            raise ValueError(f"--dump-vtk path not writable: {e}") from None
+
     from heat3d_tpu.models.heat3d import HeatSolver3D
 
     log.info(
@@ -312,6 +329,18 @@ def _main(argv: Optional[List[str]] = None) -> int:
                 axis, index, plane.shape, slice_path,
             )
 
+    vtk_path = None
+    if args.dump_vtk:
+        from heat3d_tpu.utils.vtkio import write_structured_points
+
+        full = solver.gather(u)  # collective: all processes join
+        if distributed.is_coordinator():
+            write_structured_points(
+                args.dump_vtk, full, spacing=cfg.grid.spacing
+            )
+            vtk_path = args.dump_vtk
+            log.info("dumped VTK field %s -> %s", full.shape, vtk_path)
+
     cells = cfg.grid.num_cells
     updates = cells * max(steps_done - start_step, 1)
     n_dev = cfg.mesh.num_devices
@@ -329,6 +358,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
     }
     if slice_path is not None:
         summary["slice_path"] = slice_path
+    if vtk_path is not None:
+        summary["vtk_path"] = vtk_path
 
     if args.golden_check:
         from heat3d_tpu.core import golden

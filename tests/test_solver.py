@@ -223,6 +223,71 @@ def test_cli_dump_slice(tmp_path, capsys):
     np.testing.assert_allclose(plane.astype(np.float64), want, rtol=1e-5, atol=1e-6)
 
 
+def test_vtk_roundtrip(tmp_path):
+    """The legacy-VTK writer emits x-fastest big-endian scalars that read
+    back to the exact field, for 3D volumes and 2D slice planes."""
+    from heat3d_tpu.utils.vtkio import (
+        read_structured_points,
+        write_structured_points,
+    )
+
+    rng = np.random.default_rng(3)
+    vol = rng.standard_normal((5, 6, 7)).astype(np.float32)
+    p = str(tmp_path / "vol.vtk")
+    write_structured_points(p, vol, spacing=(0.5, 1.0, 2.0))
+    got, meta = read_structured_points(p)
+    np.testing.assert_array_equal(got, vol)
+    assert meta["dimensions"] == (5, 6, 7)
+    assert meta["spacing"] == (0.5, 1.0, 2.0)
+    # x-fastest on disk: the first nx raw values are u[:, 0, 0]
+    with open(p, "rb") as f:
+        raw = f.read().partition(b"LOOKUP_TABLE default\n")[2]
+    first = np.frombuffer(raw, dtype=">f4", count=5)
+    np.testing.assert_array_equal(first.astype(np.float32), vol[:, 0, 0])
+
+    plane = rng.standard_normal((4, 3)).astype(np.float32)
+    p2 = str(tmp_path / "plane.vtk")
+    write_structured_points(p2, plane)
+    got2, meta2 = read_structured_points(p2)
+    assert meta2["dimensions"] == (4, 3, 1)
+    np.testing.assert_array_equal(got2[:, :, 0], plane)
+
+
+def test_cli_dump_vtk(tmp_path, capsys):
+    """--dump-vtk writes the final field as legacy VTK matching the golden
+    model (the reference class's ParaView dump workflow)."""
+    from heat3d_tpu.cli import main
+    from heat3d_tpu.utils.vtkio import read_structured_points
+
+    path = str(tmp_path / "field.vtk")
+    rc = main([
+        "--grid", "16", "--steps", "4", "--backend", "jnp",
+        "--dump-vtk", path,
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["vtk_path"] == path
+    field, meta = read_structured_points(path)
+    assert meta["dimensions"] == (16, 16, 16)
+    want = golden.run(
+        golden.make_init("hot-cube", (16, 16, 16)),
+        SolverConfig(grid=GridConfig.cube(16)).grid, StencilConfig(), 4,
+    )
+    np.testing.assert_allclose(
+        field.astype(np.float64), want, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_cli_dump_vtk_validates_before_run(capsys):
+    from heat3d_tpu.cli import main
+
+    rc = main([
+        "--grid", "16", "--steps", "4", "--backend", "jnp",
+        "--dump-vtk", "/no/such/dir/field.vtk",
+    ])
+    assert rc == 2
+
+
 def test_cli_dump_slice_validates_before_run(capsys):
     from heat3d_tpu.cli import main
 
