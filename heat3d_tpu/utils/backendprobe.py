@@ -54,3 +54,65 @@ def probe_device_count(timeout: Optional[float] = None) -> Optional[int]:
         return int(out)
     except ValueError:
         return None
+
+
+def wait_for_backend(
+    deadline_s: float,
+    interval_s: float = 60.0,
+    want: Optional[str] = "tpu",
+) -> Optional[str]:
+    """Probe repeatedly until the backend answers (and matches ``want`` if
+    given) or ``deadline_s`` elapses. Returns the platform name or None.
+
+    The axon pool grants the single remote chip to ONE client at a time,
+    and a client killed mid-claim (e.g. a row SIGKILLed by ``timeout``)
+    leaves a stale claim that blocks the next client until the server
+    expires it. Measurement scripts therefore gate every chip-touching
+    step on this wait: the probe child is itself timeout-bounded, and a
+    probe killed while *waiting* for a claim never held one, so the wait
+    loop cannot wedge the pool further.
+    """
+    import time
+
+    start = time.monotonic()
+    while True:
+        p = probe_platform()
+        if p is not None and (want is None or p == want):
+            return p
+        if time.monotonic() - start >= deadline_s:
+            return None
+        time.sleep(interval_s)
+
+
+def _main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Bounded backend probe. Default: one probe, print the "
+        "platform, rc 0 if it answered. --wait N keeps probing up to N "
+        "seconds for the wanted platform (the claim-expiry gate used "
+        "between measurement rows)."
+    )
+    ap.add_argument("--wait", type=float, default=0.0, metavar="SECONDS")
+    ap.add_argument("--interval", type=float, default=60.0)
+    ap.add_argument(
+        "--platform",
+        default="tpu",
+        help="required platform for --wait ('any' accepts whatever answers)",
+    )
+    args = ap.parse_args()
+    want = None if args.platform == "any" else args.platform
+    if args.wait > 0:
+        p = wait_for_backend(args.wait, args.interval, want)
+    else:
+        p = probe_platform()
+        if want is not None and p != want:
+            p = None
+    if p is None:
+        return 1
+    print(p)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

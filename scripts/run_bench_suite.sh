@@ -11,6 +11,31 @@ cd "$(dirname "$0")/.."
 
 OUT=${1:-bench_results.jsonl}
 REPORT_MD=${2:-${REPORT_MD:-BASELINE.md}}
+# Row stderr lands here (NOT /dev/null): a failing row's traceback is the
+# only evidence of WHY a session lost it. Fresh sessions truncate it in
+# lockstep with $OUT (stale tracebacks misattribute failures); APPEND
+# sessions keep it, and every session stamps a boundary header.
+SUITE_LOG="${SUITE_LOG:-${OUT%.jsonl}.err.log}"
+[[ -n "${APPEND:-}" ]] || : > "$SUITE_LOG"
+echo "=== suite session $(date -u +%FT%TZ) (APPEND=${APPEND:-}) ===" >> "$SUITE_LOG"
+
+# suite-level skip/fail notes go to OUR stderr (live view) AND the log —
+# callers truncate stderr (tpu_measure_all tails it), the log persists
+note() { echo "$*" | tee -a "$SUITE_LOG" >&2; }
+
+# The axon pool grants its single chip to one client at a time, and a row
+# SIGKILLed by its ROW_TIMEOUT leaves a stale claim that blocks the NEXT
+# row's backend init until the server expires it — unguarded, one slow row
+# cascades into every later row burning its whole timeout stuck in init.
+# So every chip-touching step first waits (killable, claim-free) until a
+# bounded probe confirms the chip answers. No-op off the axon env (CPU
+# smoke runs).
+wait_tpu() {
+  [[ -n "${PALLAS_AXON_POOL_IPS:-}" && "${JAX_PLATFORMS:-}" != cpu ]] || return 0
+  python -m heat3d_tpu.utils.backendprobe --wait "${TPU_WAIT:-1800}" \
+    --interval "${TPU_WAIT_INTERVAL:-60}" >/dev/null 2>&1 \
+    || { note "suite: TPU unreachable past TPU_WAIT; skipping: $*"; return 1; }
+}
 # APPEND=1 resumes an interrupted measurement session instead of
 # truncating the rows a prior (e.g. tunnel-wedged) run already landed;
 # configs already recorded in $OUT are skipped, not re-run (no duplicate
@@ -98,26 +123,28 @@ for stencil in ${STENCILS:-7pt 27pt}; do
           if [[ $bench == all ]] && ! has_halo "$grid" "$dtype"; then
             # resume edge: the prior run died between the throughput line
             # and the halo line — fill in just the missing halo row
-            echo "suite: backfilling halo row grid=$grid dtype=$dtype" >&2
+            note "suite: backfilling halo row grid=$grid dtype=$dtype"
+            wait_tpu "halo backfill grid=$grid" || continue
             timeout "${ROW_TIMEOUT:-900}" \
               python -m heat3d_tpu.bench --grid "$grid" \
               --steps "${STEPS:-50}" --dtype "$dtype" --mesh 1 1 1 \
-              --bench halo >> "$OUT" 2>/dev/null \
-              || echo "suite: halo backfill failed grid=$grid (rc=$?)" >&2
+              --bench halo >> "$OUT" 2>>"$SUITE_LOG" \
+              || note "suite: halo backfill failed grid=$grid (rc=$?)"
           else
-            echo "suite: already recorded $stencil grid=$grid dtype=$dtype tb=$tb" >&2
+            note "suite: already recorded $stencil grid=$grid dtype=$dtype tb=$tb"
           fi
           continue
         fi
         # a failing row (e.g. 1024^3 OOM on a small-HBM chip) skips, not
         # aborts; ROW_TIMEOUT bounds a row that hangs on a wedged tunnel
         # (one stuck 1024^3 transfer must cost one row, not the stage)
+        wait_tpu "$stencil grid=$grid dtype=$dtype tb=$tb" || continue
         timeout "${ROW_TIMEOUT:-900}" \
           python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
           --stencil "$stencil" --dtype "$dtype" --time-blocking "$tb" \
           --mesh 1 1 1 --bench "$bench" \
-          >> "$OUT" 2>/dev/null \
-          || echo "suite: skipped $stencil grid=$grid dtype=$dtype tb=$tb (rc=$?)" >&2
+          >> "$OUT" 2>>"$SUITE_LOG" \
+          || note "suite: skipped $stencil grid=$grid dtype=$dtype tb=$tb (rc=$?)"
       done
     done
   done
@@ -131,26 +158,27 @@ if [[ -z "${SKIP_BF16_COMPUTE:-}" ]]; then
   for grid in ${GRIDS:-512 1024}; do
     [[ $grid -lt 512 ]] && continue
     if has_row 7pt "$grid" bf16 2 bf16 0; then
-      echo "suite: already recorded bf16-compute grid=$grid" >&2
+      note "suite: already recorded bf16-compute grid=$grid"
       continue
     fi
+    wait_tpu "bf16-compute grid=$grid" || continue
     timeout "${ROW_TIMEOUT:-900}" \
       python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
       --dtype bf16 --compute-dtype bf16 --time-blocking 2 --mesh 1 1 1 \
-      --bench throughput >> "$OUT" 2>/dev/null \
-      || echo "suite: skipped bf16-compute grid=$grid (rc=$?)" >&2
+      --bench throughput >> "$OUT" 2>>"$SUITE_LOG" \
+      || note "suite: skipped bf16-compute grid=$grid (rc=$?)"
   done
 fi
 
 if [[ -z "${SKIP_OVERLAP:-}" ]]; then
   if has_row 7pt "${OVERLAP_GRID:-512}" fp32 1 fp32 1; then
-    echo "suite: already recorded overlap run" >&2
-  else
+    note "suite: already recorded overlap run"
+  elif wait_tpu "overlap run"; then
     timeout "${ROW_TIMEOUT:-900}" \
       python -m heat3d_tpu.bench --grid "${OVERLAP_GRID:-512}" \
       --steps "${STEPS:-50}" --overlap --mesh 1 1 1 --bench throughput \
-      >> "$OUT" 2>/dev/null \
-      || echo "suite: skipped overlap run (rc=$?)" >&2
+      >> "$OUT" 2>>"$SUITE_LOG" \
+      || note "suite: skipped overlap run (rc=$?)"
   fi
 fi
 

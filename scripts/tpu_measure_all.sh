@@ -13,12 +13,26 @@ cd "$(dirname "$0")/.."
 LOG="${LOG:-tpu_measure.log}"
 echo "=== tpu_measure_all $(date -u +%FT%TZ) ===" | tee -a "$LOG"
 
-probe() {
-  timeout "${PROBE_TIMEOUT:-120}" python -c "import jax; d=jax.devices(); \
-    assert d[0].platform=='tpu', d; print('TPU OK:', d[0])" 2>/dev/null
+# One chip-claim at a time: gate every stage on a killable probe loop so a
+# stale pool claim (left by any client killed mid-claim) costs bounded
+# waiting, not a stage timeout burned inside backend init. See
+# heat3d_tpu/utils/backendprobe.py::wait_for_backend.
+wait_tpu() {
+  python -m heat3d_tpu.utils.backendprobe \
+    --wait "${TPU_WAIT:-1800}" --interval "${TPU_WAIT_INTERVAL:-60}" \
+    >/dev/null 2>&1 \
+    || { echo "TPU unreachable past TPU_WAIT; skipping: $*" | tee -a "$LOG"
+         return 1; }
 }
-if ! probe; then
-  echo "TPU unreachable (axon tunnel wedged?) — aborting" | tee -a "$LOG"
+# a TPU measurement session is meaningless off the axon env — fail fast
+# rather than waiting TPU_WAIT for a platform that can't appear
+if [[ -z "${PALLAS_AXON_POOL_IPS:-}" || "${JAX_PLATFORMS:-axon}" == cpu ]]; then
+  echo "not an axon TPU env (PALLAS_AXON_POOL_IPS unset or cpu forced) — aborting" \
+    | tee -a "$LOG"
+  exit 1
+fi
+if ! wait_tpu "initial probe"; then
+  echo "TPU never answered — aborting" | tee -a "$LOG"
   exit 1
 fi
 
@@ -26,16 +40,20 @@ echo "--- stage 1: smoke tier" | tee -a "$LOG"
 timeout 900 python -m pytest tests/ -m tpu_smoke -q 2>&1 | tail -3 | tee -a "$LOG"
 
 echo "--- stage 2: bench suite" | tee -a "$LOG"
-timeout 3600 bash scripts/run_bench_suite.sh bench_results.jsonl 2>&1 \
-  | tail -3 | tee -a "$LOG"
+# The suite probe-gates each row internally; its stderr log (suite: ...
+# skip/fail lines + row tracebacks) is bench_results.err.log.
+timeout "${SUITE_TIMEOUT:-7200}" bash scripts/run_bench_suite.sh \
+  bench_results.jsonl 2>&1 | tail -3 | tee -a "$LOG"
 
 echo "--- stage 3: headline bench" | tee -a "$LOG"
-timeout 1200 python bench.py 2>&1 | tee -a "$LOG"
+wait_tpu "headline bench" \
+  && timeout 1200 python bench.py 2>&1 | tee -a "$LOG"
 
 echo "--- stage 3b: direct-vs-exchange A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
 for mode in direct exchange; do
   env_prefix=()
   [[ $mode == exchange ]] && env_prefix=(env HEAT3D_NO_DIRECT=1)
+  wait_tpu "A/B $mode" || continue
   out=$("${env_prefix[@]}" timeout 1200 python -m heat3d_tpu.bench \
     --grid 512 --steps 50 --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
   echo "$mode: $out" | tee -a "$LOG"
@@ -46,6 +64,7 @@ done
 echo "--- stage 3c: 27pt y-factoring A/B (512^3 fp32)" | tee -a "$LOG"
 for fy in 1 0; do
   for tb in 1 2; do
+    wait_tpu "27pt A/B fy=$fy tb=$tb" || continue
     out=$(env HEAT3D_FACTOR_Y=$fy timeout 1200 python -m heat3d_tpu.bench \
       --grid 512 --steps 50 --stencil 27pt --time-blocking $tb \
       --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
@@ -55,6 +74,7 @@ done
 
 echo "--- stage 3d: bf16-compute A/B (1024^3 tb=2)" | tee -a "$LOG"
 for cd in fp32 bf16; do
+  wait_tpu "bf16-compute A/B $cd" || continue
   out=$(timeout 1200 python -m heat3d_tpu.bench --grid 1024 --steps 50 \
     --dtype bf16 --compute-dtype $cd --time-blocking 2 --mesh 1 1 1 \
     --bench throughput 2>&1 | tail -1)
@@ -63,11 +83,13 @@ done
 
 echo "--- stage 4: profile traces" | tee -a "$LOG"
 for tb in 1 2; do
+  wait_tpu "profile tb=$tb" || continue
   GRID=512 STEPS=20 TB=$tb timeout 1200 \
     bash scripts/profile_bench.sh "/tmp/heat3d_profile_tb$tb" 2>&1 \
     | tee -a "$LOG"
 done
 # 27pt VPU-bound claim: capture the op mix at the ceiling (VERDICT r2 #4)
+wait_tpu "profile 27pt" && \
 GRID=512 STEPS=20 TB=1 STENCIL=27pt timeout 1200 \
   bash scripts/profile_bench.sh "/tmp/heat3d_profile_27pt" 2>&1 \
   | tee -a "$LOG"

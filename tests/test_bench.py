@@ -172,3 +172,30 @@ def test_scaling_rows_weak_and_strong():
     # baselines with a different time_blocking don't match
     results[0]["time_blocking"] = 2
     assert all(r["mode"] != "weak" for r in scaling_rows(results))
+
+
+def test_backendprobe_wait_cli_claim_gate():
+    """The measurement scripts gate every chip-claiming row on
+    ``backendprobe --wait`` (stale-claim defense, see wait_for_backend's
+    docstring). Contract: rc 0 + platform printed when the backend
+    answers with the wanted platform; rc 1 (after bounded waiting, not a
+    hang) when the wanted platform never appears."""
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ok = subprocess.run(
+        [sys.executable, "-m", "heat3d_tpu.utils.backendprobe",
+         "--wait", "5", "--interval", "1", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=root,
+    )
+    assert ok.returncode == 0, ok.stderr
+    assert ok.stdout.strip() == "cpu"
+    # wanted platform never appears on this backend -> bounded rc 1
+    miss = subprocess.run(
+        [sys.executable, "-m", "heat3d_tpu.utils.backendprobe",
+         "--wait", "3", "--interval", "1", "--platform", "tpu"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=root,
+    )
+    assert miss.returncode == 1, (miss.stdout, miss.stderr)
