@@ -68,13 +68,21 @@ def _remaining() -> float:
 def _probe_with_retry():
     """Bounded, killable backend probe with retry/backoff.
 
-    Defaults (3 x 60 s probes + 2 x 15 s backoff = 210 s worst case) are
-    sized so probing plus one measurement rung finishes — and prints the
-    JSON line — inside typical outer harness timeouts."""
+    Defaults (8 x 60 s probes + 7 x 60 s backoffs ~= 900 s worst case —
+    sized to outlast a stale pool claim) are still capped by the shared
+    deadline: probing stops early whenever the remaining budget wouldn't
+    leave the CPU fallback its reserve, so the JSON line always lands
+    inside HEAT3D_BENCH_DEADLINE."""
     from heat3d_tpu.utils.backendprobe import probe_platform, probe_timeout
 
-    attempts = int(os.environ.get("HEAT3D_BENCH_PROBE_ATTEMPTS", "3"))
-    backoff = float(os.environ.get("HEAT3D_BENCH_PROBE_BACKOFF", "15"))
+    # Defaults sized for the axon pool's claim semantics (one client at a
+    # time; a client killed mid-claim leaves a stale claim the server
+    # takes minutes to expire): 8 x 60 s probes with 60 s backoffs keep
+    # probing ~14 min — long enough to outlast a stale claim — while the
+    # shared deadline still shrinks/stops probing so the CPU fallback
+    # always gets its reserve.
+    attempts = int(os.environ.get("HEAT3D_BENCH_PROBE_ATTEMPTS", "8"))
+    backoff = float(os.environ.get("HEAT3D_BENCH_PROBE_BACKOFF", "60"))
     for i in range(attempts):
         # probes shrink to the shared deadline like rung timeouts do: a
         # tight HEAT3D_BENCH_DEADLINE must not be eaten by probing before

@@ -376,7 +376,14 @@ def _main(argv: Optional[List[str]] = None) -> int:
         rel = err / max(float(np.max(np.abs(g))), 1e-300)
         summary["golden_max_abs_err"] = err
         summary["golden_rel_err"] = rel
-        tol = 1e-5 if cfg.precision.storage == "float32" else 5e-2
+        # tolerance follows the loosest dtype in the chain: bf16 anywhere
+        # (storage OR stencil compute) caps accuracy at bf16's ~3
+        # decimal digits regardless of how the field is stored
+        fp32_chain = (
+            cfg.precision.storage == "float32"
+            and cfg.precision.compute == "float32"
+        )
+        tol = 1e-5 if fp32_chain else 5e-2
         summary["golden_pass"] = bool(rel < tol)
 
     if distributed.is_coordinator():

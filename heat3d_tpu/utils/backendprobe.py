@@ -88,9 +88,10 @@ def _main() -> int:
     import argparse
 
     ap = argparse.ArgumentParser(
-        description="Bounded backend probe. Default: one probe, print the "
-        "platform, rc 0 if it answered. --wait N keeps probing up to N "
-        "seconds for the wanted platform (the claim-expiry gate used "
+        description="Bounded backend probe. Default: one probe, rc 0 and "
+        "the platform printed only if the WANTED platform (--platform, "
+        "default tpu; 'any' accepts whatever answers) responded. --wait N "
+        "keeps probing up to N seconds (the claim-expiry gate used "
         "between measurement rows)."
     )
     ap.add_argument("--wait", type=float, default=0.0, metavar="SECONDS")

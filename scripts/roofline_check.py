@@ -1,18 +1,35 @@
-"""Compare measured throughput rows against the HBM traffic-model ceilings.
+"""Compare measured throughput rows against the HBM traffic-model ceilings
+and a VPU op-cost model of the tap chain.
 
 Reads a bench_results.jsonl (bench.harness rows) and prints, per throughput
 row, the step path it ran, its bytes/cell/update, the bandwidth ceiling at
-the given HBM rate, and the achieved fraction — the "where did the rest
-go" accounting BASELINE.md's traffic model sets up.
+the given HBM rate, the vector-op count of the emitted tap chain (and the
+VPU ceiling when ``--vpu-gops`` is given), and the achieved fraction of the
+binding ceiling — the "where did the rest go" accounting BASELINE.md's
+traffic model sets up.
 
-Usage: python scripts/roofline_check.py bench_results.jsonl [--hbm-gbps 819]
+The op count comes from :func:`heat3d_tpu.core.stencils.effective_num_taps`
+driving the REAL accumulate_taps emission under the current factoring env
+(HEAT3D_FACTOR_Y / HEAT3D_FACTOR_7PT) — so the printed chain cost is the
+one the rows actually compiled *if* the env matches the measurement run
+(each FMA term and each cached plane/row sum counts as one full-volume
+vector op; kernel plane-assembly overhead is not modeled). ``--vpu-gops``
+has no trustworthy public per-chip number; calibrate it from a measured
+compute-bound row (e.g. 27pt tb=1: gops ≈ ops/cell x measured Gcell/s)
+and then use it to sanity-check the OTHER compute-bound rows.
+
+Usage: python scripts/roofline_check.py bench_results.jsonl
+           [--hbm-gbps 819] [--vpu-gops N]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def bytes_per_cell_update(row) -> tuple[float, str]:
@@ -38,11 +55,29 @@ def bytes_per_cell_update(row) -> tuple[float, str]:
     return per_update, path
 
 
+def vpu_ops_per_cell_update(row) -> int:
+    """Vector ops/cell/update of the tap chain the row's stencil emits
+    under the current factoring env (terms + cached plane/row sums —
+    see effective_num_taps). Tap VALUES don't matter for the count, only
+    which offsets are nonzero, so nominal alpha/dt/spacing are fine."""
+    from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
+
+    taps = stencil_taps(
+        STENCILS[row.get("stencil", "7pt")],
+        alpha=0.1, dt=0.05, spacing=(1.0, 1.0, 1.0),
+    )
+    return effective_num_taps(taps)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("results")
     ap.add_argument("--hbm-gbps", type=float, default=819.0,
                     help="chip HBM bandwidth (GB/s); v5e ~819, v5p ~2765")
+    ap.add_argument("--vpu-gops", type=float, default=None,
+                    help="VPU vector throughput (Gop/s, one op = one "
+                    "full-width FMA or add); calibrate from a measured "
+                    "compute-bound row — no default on purpose")
     args = ap.parse_args()
 
     rows = []
@@ -61,11 +96,18 @@ def main() -> int:
         print("no throughput rows found", file=sys.stderr)
         return 1
 
-    print(f"{'grid':>6} {'dtype':>8} {'tb':>2} {'path':>16} "
-          f"{'B/cell/upd':>10} {'ceiling':>9} {'measured':>9} {'achieved':>8}")
+    print(f"{'grid':>6} {'dtype':>8} {'st':>4} {'tb':>2} {'path':>16} "
+          f"{'B/cell/upd':>10} {'ops':>4} {'ceiling':>9} {'bind':>4} "
+          f"{'measured':>9} {'achieved':>8}")
     for r in rows:
         per_update, path = bytes_per_cell_update(r)
-        ceiling = args.hbm_gbps / per_update  # Gcell/s/chip
+        bw_ceiling = args.hbm_gbps / per_update  # Gcell/s/chip
+        ops = vpu_ops_per_cell_update(r)
+        ceiling, bind = bw_ceiling, "hbm"
+        if args.vpu_gops is not None:
+            vpu_ceiling = args.vpu_gops / ops
+            if vpu_ceiling < bw_ceiling:
+                ceiling, bind = vpu_ceiling, "vpu"
         meas = r["gcell_per_sec_per_chip"]
         grid = r["grid"][0] if len(set(r["grid"])) == 1 else "x".join(
             map(str, r["grid"]))
@@ -74,8 +116,9 @@ def main() -> int:
         # but label it so bf16-compute A/B rows are tellable apart
         if r.get("compute_dtype", "float32") != "float32":
             flag = " (c=bf16)" + flag
-        print(f"{grid:>6} {r['dtype']:>8} {r.get('time_blocking', 1):>2} "
-              f"{path:>16} {per_update:>10.1f} {ceiling:>9.1f} "
+        print(f"{grid:>6} {r['dtype']:>8} {r.get('stencil', '7pt'):>4} "
+              f"{r.get('time_blocking', 1):>2} {path:>16} "
+              f"{per_update:>10.1f} {ops:>4} {ceiling:>9.1f} {bind:>4} "
               f"{meas:>9.2f} {meas / ceiling:>7.1%}{flag}")
     return 0
 

@@ -46,8 +46,11 @@ timeout "${SUITE_TIMEOUT:-7200}" bash scripts/run_bench_suite.sh \
   bench_results.jsonl 2>&1 | tail -3 | tee -a "$LOG"
 
 echo "--- stage 3: headline bench" | tee -a "$LOG"
+# outer timeout > bench.py's internal deadline (default 1500 s, which now
+# includes up to ~900 s of claim-outlasting probes) so the JSON line always
+# lands before SIGKILL
 wait_tpu "headline bench" \
-  && timeout 1200 python bench.py 2>&1 | tee -a "$LOG"
+  && timeout 1800 python bench.py 2>&1 | tee -a "$LOG"
 
 echo "--- stage 3b: direct-vs-exchange A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
 for mode in direct exchange; do
@@ -73,12 +76,17 @@ for fy in 1 0; do
 done
 
 echo "--- stage 3d: bf16-compute A/B (1024^3 tb=2)" | tee -a "$LOG"
-for cd in fp32 bf16; do
-  wait_tpu "bf16-compute A/B $cd" || continue
+# storage/compute grid: bf16/fp32 vs bf16/bf16 answers whether the bf16
+# tb=2 ceiling gap is VPU-width-bound; fp32/bf16 runs the same width A/B
+# on the fp32 traffic shape (accuracy gates: tests/test_solver.py bf16
+# tiers). fp32/fp32 is the committed headline row (suite stage 2).
+for dt in "bf16 fp32" "bf16 bf16" "fp32 bf16"; do
+  read -r st cd <<<"$dt"
+  wait_tpu "compute A/B $st/$cd" || continue
   out=$(timeout 1200 python -m heat3d_tpu.bench --grid 1024 --steps 50 \
-    --dtype bf16 --compute-dtype $cd --time-blocking 2 --mesh 1 1 1 \
+    --dtype $st --compute-dtype $cd --time-blocking 2 --mesh 1 1 1 \
     --bench throughput 2>&1 | tail -1)
-  echo "compute=$cd: $out" | tee -a "$LOG"
+  echo "storage=$st compute=$cd: $out" | tee -a "$LOG"
 done
 
 echo "--- stage 4: profile traces" | tee -a "$LOG"

@@ -71,6 +71,23 @@ def test_bf16_compute_solver_tracks_fp32():
     assert np.max(np.abs(a - b)) < 0.05 * max(1.0, np.max(np.abs(b)))
 
 
+def test_bf16_compute_fp32_storage_tracks_fp32():
+    """fp32 storage + bf16 stencil math (the VPU-width A/B on the fp32
+    traffic shape): same bf16-order accuracy gate — compute rounding
+    dominates, storage keeps full precision between steps."""
+    sm, _ = make_solver(
+        precision=Precision(
+            storage="float32", compute="bfloat16", residual="float32"
+        )
+    )
+    s32, _ = make_solver(precision=Precision.fp32())
+    um = sm.run(sm.init_state("gaussian"), 5)
+    u32 = s32.run(s32.init_state("gaussian"), 5)
+    a = sm.gather(um).astype(np.float32)
+    b = s32.gather(u32)
+    assert np.max(np.abs(a - b)) < 0.05 * max(1.0, np.max(np.abs(b)))
+
+
 def test_convergence_mode():
     solver, _ = make_solver()
     u = solver.init_state("gaussian")
