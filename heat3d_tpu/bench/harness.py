@@ -96,7 +96,22 @@ def bench_throughput(
         "rtt_dominated": rtt_dominated,
         "gcell_per_sec": gcells,
         "gcell_per_sec_per_chip": gcells / cfg.mesh.num_devices,
+        # Emitted-chain provenance: the factoring knobs are env vars, so
+        # without this a HEAT3D_FACTOR_Y=0 A/B row is indistinguishable
+        # from a default suite row, and analysis tools re-deriving the op
+        # count later (under a different env) would mislabel it.
+        "chain_ops": _chain_ops(cfg),
     }
+
+
+def _chain_ops(cfg: SolverConfig) -> int:
+    """Vector ops/cell/update of the tap chain this config emits under the
+    CURRENT factoring env (terms + cached plane/row sums — the
+    effective_num_taps contract). Recorded per row; scripts/
+    roofline_check.py prefers this over re-derivation."""
+    from heat3d_tpu.core.stencils import chain_ops_for
+
+    return chain_ops_for(cfg.stencil.kind)
 
 
 def bench_halo(

@@ -245,6 +245,19 @@ def effective_num_taps(taps: np.ndarray) -> int:
     return n_terms + len(caches)
 
 
+def chain_ops_for(kind: str) -> int:
+    """Vector ops/cell/update the named stencil's chain emits under the
+    CURRENT factoring env — the one shared derivation for measurement
+    provenance (bench.harness records it per row) and analysis fallback
+    (scripts/roofline_check.py for rows predating the field). Tap VALUES
+    don't affect the count, only which offsets are nonzero, so nominal
+    alpha/dt/spacing are used."""
+    taps = stencil_taps(
+        STENCILS[kind], alpha=0.1, dt=0.05, spacing=(1.0, 1.0, 1.0)
+    )
+    return effective_num_taps(taps)
+
+
 def accumulate_taps(taps_flat, term, scalar):
     """THE canonical tap-accumulation order, shared by every compute
     backend (jnp path, streaming/windowed/direct Pallas kernels) so

@@ -56,17 +56,17 @@ def bytes_per_cell_update(row) -> tuple[float, str]:
 
 
 def vpu_ops_per_cell_update(row) -> int:
-    """Vector ops/cell/update of the tap chain the row's stencil emits
-    under the current factoring env (terms + cached plane/row sums —
-    see effective_num_taps). Tap VALUES don't matter for the count, only
-    which offsets are nonzero, so nominal alpha/dt/spacing are fine."""
-    from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
+    """Vector ops/cell/update of the row's tap chain. Prefers the
+    ``chain_ops`` the harness recorded at measurement time (exact even for
+    factoring-knob A/B rows); falls back to re-deriving under the CURRENT
+    factoring env for rows predating that field. Tap VALUES don't matter
+    for the count, only which offsets are nonzero, so nominal
+    alpha/dt/spacing are fine for the fallback."""
+    if "chain_ops" in row:
+        return row["chain_ops"]
+    from heat3d_tpu.core.stencils import chain_ops_for
 
-    taps = stencil_taps(
-        STENCILS[row.get("stencil", "7pt")],
-        alpha=0.1, dt=0.05, spacing=(1.0, 1.0, 1.0),
-    )
-    return effective_num_taps(taps)
+    return chain_ops_for(row.get("stencil", "7pt"))
 
 
 def main() -> int:

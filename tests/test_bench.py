@@ -199,3 +199,20 @@ def test_backendprobe_wait_cli_claim_gate():
         capture_output=True, text=True, timeout=120, env=env, cwd=root,
     )
     assert miss.returncode == 1, (miss.stdout, miss.stderr)
+
+
+def test_throughput_row_records_chain_ops(monkeypatch):
+    """Rows carry the emitted chain's op count so factoring-knob A/B rows
+    stay tellable apart after the env is gone (roofline_check prefers it)."""
+    from heat3d_tpu.bench.harness import _chain_ops
+    from heat3d_tpu.core.config import GridConfig, SolverConfig, StencilConfig
+
+    cfg27 = SolverConfig(
+        grid=GridConfig.cube(8), stencil=StencilConfig(kind="27pt")
+    )
+    monkeypatch.delenv("HEAT3D_FACTOR_Y", raising=False)
+    assert _chain_ops(cfg27) == 15  # x+y-factored chain
+    monkeypatch.setenv("HEAT3D_FACTOR_Y", "0")
+    assert _chain_ops(cfg27) == 19  # x-factored only
+    cfg7 = SolverConfig(grid=GridConfig.cube(8))
+    assert _chain_ops(cfg7) == 7
