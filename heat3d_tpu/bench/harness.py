@@ -101,7 +101,28 @@ def bench_throughput(
         # from a default suite row, and analysis tools re-deriving the op
         # count later (under a different env) would mislabel it.
         "chain_ops": _chain_ops(cfg),
+        # Same provenance need for the transport knob: HEAT3D_NO_DIRECT=1
+        # A/B rows carry identical config fields to direct rows but run
+        # the exchange path at ~2x the HBM traffic — record the RESOLVED
+        # selection (the real selector, not the env) so the traffic model
+        # can't mislabel them.
+        "direct_path": _resolved_direct(cfg),
     }
+
+
+def _resolved_direct(cfg: SolverConfig) -> bool:
+    """Whether this config's step resolves to the BC-fused direct kernels
+    (parallel.step._direct_kernel_fn — honors HEAT3D_NO_DIRECT, VMEM
+    feasibility, dtype support, and the faces-direct multichip tier)."""
+    from heat3d_tpu.parallel.step import _direct_kernel_fn
+
+    if cfg.halo != "ppermute" or cfg.time_blocking not in (1, 2):
+        return False
+    # multichip=True verbatim like both step builders (step.py's tb=1 and
+    # tb=2 call sites); _direct_kernel_fn itself owns the mesh gating
+    return _direct_kernel_fn(
+        cfg, cfg.time_blocking, multichip=True
+    ) is not None
 
 
 def _chain_ops(cfg: SolverConfig) -> int:

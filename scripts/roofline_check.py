@@ -42,8 +42,12 @@ def bytes_per_cell_update(row) -> tuple[float, str]:
     overlap = row.get("overlap", False)
     # the direct kernels apply on unpadded shards for ppermute transport;
     # DMA transport and tb>2 keep the padded exchange (one extra volume
-    # read+write per exchange)
-    direct = halo == "ppermute" and tb in (1, 2)
+    # read+write per exchange). Prefer the RESOLVED selection the harness
+    # recorded (exact even for HEAT3D_NO_DIRECT A/B rows); derive for
+    # legacy rows.
+    direct = row.get("direct_path")
+    if direct is None:
+        direct = halo == "ppermute" and tb in (1, 2)
     if direct and not (overlap and tb == 2):
         per_update = 2 * item / tb  # one read + one write per sweep of tb
         path = f"direct{'' if tb == 1 else '2'}{'' if single else '+faces'}"
@@ -71,27 +75,40 @@ def vpu_ops_per_cell_update(row) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("results")
+    ap.add_argument("results", nargs="+",
+                    help="one or more row files (bench_results.jsonl plus "
+                    "e.g. A/B rows extracted from tpu_measure.log — the "
+                    "factoring A/B stages log their rows rather than "
+                    "appending them to the suite record)")
     ap.add_argument("--hbm-gbps", type=float, default=819.0,
                     help="chip HBM bandwidth (GB/s); v5e ~819, v5p ~2765")
     ap.add_argument("--vpu-gops", type=float, default=None,
                     help="VPU vector throughput (Gop/s, one op = one "
                     "full-width FMA or add); calibrate from a measured "
                     "compute-bound row — no default on purpose")
+    ap.add_argument("--fit", action="store_true",
+                    help="per (grid, dtype, tb, path) group with >=2 "
+                    "distinct chain_ops values, fit time/cell/update = "
+                    "a + b*ops: linearity in ops IS the compute-bound "
+                    "evidence, 1/b the marginal VPU rate, a the per-cell "
+                    "fixed cost (loads/stores/plane assembly)")
     args = ap.parse_args()
 
     rows = []
-    with open(args.results) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                r = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(r, dict) and r.get("bench") == "throughput":
-                rows.append(r)
+    for results in args.results:
+        with open(results) as f:
+            for line in f:
+                # tolerate log-style prefixes ("factor_y=0 tb=1: {...}")
+                line = line.strip()
+                brace = line.find("{")
+                if brace < 0:
+                    continue
+                try:
+                    r = json.loads(line[brace:])
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(r, dict) and r.get("bench") == "throughput":
+                    rows.append(r)
     if not rows:
         print("no throughput rows found", file=sys.stderr)
         return 1
@@ -120,7 +137,77 @@ def main() -> int:
               f"{r.get('time_blocking', 1):>2} {path:>16} "
               f"{per_update:>10.1f} {ops:>4} {ceiling:>9.1f} {bind:>4} "
               f"{meas:>9.2f} {meas / ceiling:>7.1%}{flag}")
+
+    if args.fit:
+        _fit_op_cost(rows)
     return 0
+
+
+def _fit_op_cost(rows) -> None:
+    """Least-squares time/cell/update = a + b*ops over rows that differ
+    ONLY in their emitted chain (same grid/dtype/tb/path). A good linear
+    fit is direct evidence the kernels are compute-bound in chain ops;
+    a >> b would instead indict fixed per-cell cost (assembly/shifts)."""
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for r in rows:
+        if r.get("rtt_dominated"):
+            continue
+        _, path = bytes_per_cell_update(r)
+        # compute_dtype/backend in the key: a bf16-compute A/B row has the
+        # same chain_ops as its fp32-compute twin but different per-op
+        # cost — pooling them would corrupt the fit silently
+        key = (
+            tuple(r["grid"]), r["dtype"],
+            r.get("compute_dtype", "float32"), r.get("backend", "auto"),
+            r.get("time_blocking", 1), path,
+        )
+        ns_per_cell = 1.0 / r["gcell_per_sec_per_chip"]  # ns/cell/update
+        groups[key].append((vpu_ops_per_cell_update(r), ns_per_cell))
+    printed = False
+    for key, pts in sorted(groups.items()):
+        by_ops = {}
+        for ops, t in pts:
+            by_ops.setdefault(ops, []).append(t)
+        if len(by_ops) < 2:
+            continue
+        xs, ys = zip(*((o, min(ts)) for o, ts in sorted(by_ops.items())))
+        n = len(xs)
+        mx, my = sum(xs) / n, sum(ys) / n
+        sxx = sum((x - mx) ** 2 for x in xs)
+        b = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / sxx
+        a = my - b * mx
+        if n >= 3:
+            ss_res = sum((y - (a + b * x)) ** 2 for x, y in zip(xs, ys))
+            ss_tot = sum((y - my) ** 2 for y in ys) or 1e-30
+            fit_q = f"R^2={1 - ss_res / ss_tot:.3f}"
+        else:
+            # a line through 2 points always "fits"; don't dress that up
+            fit_q = "2-point (no linearity evidence)"
+        grid, dtype, cdtype, backend, tb, path = key
+        cflag = "" if cdtype == "float32" else f" c={cdtype}"
+        glabel = (f"{grid[0]}^3" if len(set(grid)) == 1
+                  else "x".join(map(str, grid)))
+        if b <= 0:
+            # higher-ops rows timed FASTER: noise or a confound — that's
+            # anti-evidence of compute-boundedness, not an infinite rate
+            verdict = "non-positive slope — unfittable/not compute-bound"
+        else:
+            verdict = (
+                f"marginal {1.0 / b:.0f} Gop/s, "
+                f"fixed {a / (a + b * xs[0]):.0%} of the {xs[0]}-op chain"
+            )
+        print(
+            f"\nfit {glabel} {dtype}{cflag} tb={tb} {path}: "
+            f"t/cell = {a:.3f} + {b:.4f}*ops ns "
+            f"({verdict}, {fit_q}, points={list(by_ops)})"
+        )
+        printed = True
+    if not printed:
+        print("\nfit: no group has >=2 distinct chain_ops values "
+              "(need factoring A/B rows, e.g. HEAT3D_FACTOR_Y=0)",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -216,3 +216,19 @@ def test_throughput_row_records_chain_ops(monkeypatch):
     assert _chain_ops(cfg27) == 19  # x-factored only
     cfg7 = SolverConfig(grid=GridConfig.cube(8))
     assert _chain_ops(cfg7) == 7
+
+
+def test_throughput_row_records_resolved_direct_path(monkeypatch):
+    """direct_path records the REAL selector's decision: True when the
+    direct kernels can run (interpret mode stands in for TPU off-chip),
+    False under HEAT3D_NO_DIRECT=1 — so A/B transport rows stay tellable
+    apart in the traffic model."""
+    from heat3d_tpu.bench.harness import _resolved_direct
+    from heat3d_tpu.core.config import GridConfig, SolverConfig
+
+    cfg = SolverConfig(grid=GridConfig.cube(16))
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    monkeypatch.delenv("HEAT3D_NO_DIRECT", raising=False)
+    assert _resolved_direct(cfg) is True
+    monkeypatch.setenv("HEAT3D_NO_DIRECT", "1")
+    assert _resolved_direct(cfg) is False
