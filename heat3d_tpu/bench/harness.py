@@ -101,6 +101,7 @@ def bench_throughput(
         # from a default suite row, and analysis tools re-deriving the op
         # count later (under a different env) would mislabel it.
         "chain_ops": _chain_ops(cfg),
+        "mehrstellen_route": _mehrstellen_route(cfg),
         # Same provenance need for the transport knob: HEAT3D_NO_DIRECT=1
         # A/B rows carry identical config fields to direct rows but run
         # the exchange path at ~2x the HBM traffic — record the RESOLVED
@@ -126,13 +127,47 @@ def _resolved_direct(cfg: SolverConfig) -> bool:
 
 
 def _chain_ops(cfg: SolverConfig) -> int:
-    """Vector ops/cell/update of the tap chain this config emits under the
-    CURRENT factoring env (terms + cached plane/row sums — the
-    effective_num_taps contract). Recorded per row; scripts/
-    roofline_check.py prefers this over re-derivation."""
-    from heat3d_tpu.core.stencils import chain_ops_for
+    """Vector ops/cell/update of the local compute this config runs under
+    the CURRENT env: the mehrstellen separable route's canonical count
+    when that route is what executes (knob on + taps decompose + the jnp
+    apply is the resolved local compute), else the tap chain's
+    effective_num_taps. Recorded per row; scripts/roofline_check.py
+    prefers this over re-derivation."""
+    from heat3d_tpu.core.stencils import MEHRSTELLEN_OPS, chain_ops_for
 
+    if _mehrstellen_route(cfg):
+        return MEHRSTELLEN_OPS
     return chain_ops_for(cfg.stencil.kind)
+
+
+def _mehrstellen_route(cfg: SolverConfig) -> bool:
+    """Whether the separable S+F route actually executes for this config:
+    knob on, taps decompose, and the local compute resolves to the jnp
+    apply (explicit --backend jnp, or auto off-TPU; kernel backends keep
+    the tap chain regardless of the knob)."""
+    from heat3d_tpu.core.stencils import (
+        STENCILS,
+        decompose_mehrstellen,
+        mehrstellen_enabled,
+        stencil_taps,
+    )
+
+    if not mehrstellen_enabled():
+        return False
+    taps = stencil_taps(
+        STENCILS[cfg.stencil.kind],
+        alpha=cfg.grid.alpha,
+        dt=cfg.grid.effective_dt(),
+        spacing=cfg.grid.spacing,
+    )
+    if decompose_mehrstellen(taps) is None:
+        return False
+    backend = cfg.backend
+    if backend == "auto":
+        import jax
+
+        backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    return backend == "jnp"
 
 
 def bench_halo(

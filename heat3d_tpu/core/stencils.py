@@ -245,6 +245,61 @@ def effective_num_taps(taps: np.ndarray) -> int:
     return n_terms + len(caches)
 
 
+def decompose_mehrstellen(taps: np.ndarray):
+    """Factor 3x3x3 update taps as ``T = a*delta + b*S + d*F`` where
+    ``S = [1,3,1] (x) [1,3,1] (x) [1,3,1]`` (fully separable) and ``F`` is
+    the 6-face indicator — or None when the set doesn't decompose (or has
+    no separable part, b == 0, where the factored tap chain already wins).
+
+    The isotropic 27-point update taps decompose exactly (their
+    corner:edge ratio is 1:3 by construction), which turns the 27-tap
+    apply into three 1D [1,3,1] convolutions (2 ops each, shifted reads
+    reusable across axes) plus a 7-point face correction — the candidate
+    route for the VPU-bound 27pt chain (see scripts/roofline_check.py
+    --fit). Returns (a, b, d) floats."""
+    t = np.asarray(taps, dtype=np.float64)
+    b = float(t[0, 0, 0])
+    if b == 0.0:
+        return None
+    d = float(t[0, 1, 1]) - 9.0 * b
+    a = float(t[1, 1, 1]) - 27.0 * b
+    recon = np.full((3, 3, 3), b)
+    for axis_val in range(3):
+        idx = [slice(None)] * 3
+        idx[axis_val] = 1
+        recon[tuple(idx)] *= 3.0
+    for off in ((0, 1, 1), (2, 1, 1), (1, 0, 1), (1, 2, 1), (1, 1, 0), (1, 1, 2)):
+        recon[off] += d
+    recon[1, 1, 1] += a
+    scale = np.max(np.abs(t)) or 1.0
+    if not np.allclose(recon, t, rtol=0, atol=1e-12 * scale):
+        return None
+    return a, b, d
+
+
+# Vector ops/cell/update of the canonical mehrstellen emission (the order
+# pinned in ops.stencil_jnp._apply_mehrstellen_padded's docstring):
+# z131 2 + y131 2 + S 2 + px/py/pz 3 + psum 2 + final combine 3 = 14.
+# Lives beside the route gate so count and emission move together;
+# pinned against the docstring by tests/test_step_jnp.py.
+MEHRSTELLEN_OPS = 14
+
+
+def mehrstellen_enabled() -> bool:
+    """HEAT3D_MEHRSTELLEN (same convention as the sibling factoring knobs:
+    unset/'0'/'false' = off) switches eligible stencils (today: the 27pt
+    set) to the separable S+F route — implemented in the jnp apply only,
+    so it is a ``--backend jnp`` A/B lever; under kernel backends the
+    chain still runs (and faces-direct shell patches would mix routes at
+    rounding level). Default OFF until the on-chip A/B lands — the
+    committed measured record runs the factored tap chain."""
+    import os
+
+    return os.environ.get("HEAT3D_MEHRSTELLEN", "").lower() not in (
+        "", "0", "false",
+    )
+
+
 def chain_ops_for(kind: str) -> int:
     """Vector ops/cell/update the named stencil's chain emits under the
     CURRENT factoring env — the one shared derivation for measurement

@@ -19,7 +19,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from heat3d_tpu.core.config import BoundaryCondition, Precision
-from heat3d_tpu.core.stencils import accumulate_taps, flat_taps, nonzero_taps
+from heat3d_tpu.core.stencils import (
+    accumulate_taps,
+    decompose_mehrstellen,
+    flat_taps,
+    mehrstellen_enabled,
+    nonzero_taps,
+)
 
 
 def pad_local(
@@ -46,6 +52,12 @@ def apply_taps_padded(
     nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
     out_dtype = out_dtype or up.dtype
     upc = up.astype(compute_dtype)
+    if mehrstellen_enabled():
+        coeffs = decompose_mehrstellen(taps)
+        if coeffs is not None:
+            return _apply_mehrstellen_padded(
+                upc, coeffs, compute_dtype
+            ).astype(out_dtype)
     flat = flat_taps(taps)
     assert flat, "stencil has no taps"
     cache = {}
@@ -70,6 +82,36 @@ def apply_taps_padded(
         flat, term, lambda w: jnp.asarray(w, compute_dtype)
     )
     return acc.astype(out_dtype)
+
+
+def _apply_mehrstellen_padded(upc: jax.Array, coeffs, compute_dtype):
+    """Separable route for taps that factor as ``a*delta + b*S + d*F``
+    (core.stencils.decompose_mehrstellen): three 1D [1,3,1] convolutions
+    build the S term, the face sum builds F, one final 3-term combine.
+
+    THE canonical mehrstellen op order (any future kernel implementation
+    must match it exactly so cross-backend comparisons agree to FMA
+    rounding):
+      z131 = (z- + z+) + 3*u          per z-line, on the padded array
+      y131 = (y- + y+) + 3*z131      per y-line of z131
+      S    = (x- + x+) + 3*y131      over x-planes of y131
+      psum = ((px + py) + pz)         face sums of the padded array
+      out  = (a*u0 + b*S) + d*psum
+    """
+    nx, ny, nz = upc.shape[0] - 2, upc.shape[1] - 2, upc.shape[2] - 2
+    a, b, d = (jnp.asarray(c, compute_dtype) for c in coeffs)
+    three = jnp.asarray(3.0, compute_dtype)
+
+    z131 = (upc[:, :, 0:nz] + upc[:, :, 2 : nz + 2]) + three * upc[:, :, 1 : nz + 1]
+    y131 = (z131[:, 0:ny] + z131[:, 2 : ny + 2]) + three * z131[:, 1 : ny + 1]
+    s = (y131[0:nx] + y131[2 : nx + 2]) + three * y131[1 : nx + 1]
+
+    u0 = upc[1 : nx + 1, 1 : ny + 1, 1 : nz + 1]
+    px = upc[0:nx, 1 : ny + 1, 1 : nz + 1] + upc[2 : nx + 2, 1 : ny + 1, 1 : nz + 1]
+    py = upc[1 : nx + 1, 0:ny, 1 : nz + 1] + upc[1 : nx + 1, 2 : ny + 2, 1 : nz + 1]
+    pz = upc[1 : nx + 1, 1 : ny + 1, 0:nz] + upc[1 : nx + 1, 1 : ny + 1, 2 : nz + 2]
+    psum = (px + py) + pz
+    return (a * u0 + b * s) + d * psum
 
 
 def step_single_device(

@@ -232,3 +232,29 @@ def test_throughput_row_records_resolved_direct_path(monkeypatch):
     assert _resolved_direct(cfg) is True
     monkeypatch.setenv("HEAT3D_NO_DIRECT", "1")
     assert _resolved_direct(cfg) is False
+
+
+def test_chain_ops_tracks_mehrstellen_route(monkeypatch):
+    """chain_ops provenance must record what EXECUTES: the separable
+    route's canonical 14-op count when the mehrstellen knob engages the
+    jnp apply, the tap chain's count everywhere else (kernel backends
+    ignore the knob; 7pt taps don't decompose)."""
+    from heat3d_tpu.bench.harness import _chain_ops
+    from heat3d_tpu.core.config import GridConfig, SolverConfig, StencilConfig
+    from heat3d_tpu.core.stencils import MEHRSTELLEN_OPS
+
+    cfg = SolverConfig(
+        grid=GridConfig.cube(8), stencil=StencilConfig(kind="27pt"),
+        backend="jnp",
+    )
+    monkeypatch.delenv("HEAT3D_MEHRSTELLEN", raising=False)
+    monkeypatch.delenv("HEAT3D_FACTOR_Y", raising=False)
+    assert _chain_ops(cfg) == 15
+    monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+    assert _chain_ops(cfg) == MEHRSTELLEN_OPS == 14
+    # kernel backend keeps the chain regardless of the knob
+    import dataclasses
+    assert _chain_ops(dataclasses.replace(cfg, backend="pallas")) == 15
+    # 7pt has no separable part
+    cfg7 = SolverConfig(grid=GridConfig.cube(8), backend="jnp")
+    assert _chain_ops(cfg7) == 7

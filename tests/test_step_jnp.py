@@ -98,3 +98,51 @@ def test_pad_local_wrap_and_constant():
     np.testing.assert_array_equal(
         np.asarray(c), np.pad(np.asarray(u), 1, constant_values=9.0)
     )
+
+
+def test_decompose_mehrstellen():
+    """The isotropic 27pt update taps factor exactly as a*delta + b*S + d*F
+    (corner:edge ratio 1:3 by construction); the 7pt set has no separable
+    part and must return None."""
+    from heat3d_tpu.core.stencils import decompose_mehrstellen
+
+    c = decompose_mehrstellen(taps_for("27pt"))
+    assert c is not None
+    a, b, d = c
+    assert b != 0.0
+    assert decompose_mehrstellen(taps_for("7pt")) is None
+    # perturb one corner -> no longer decomposable
+    bad = taps_for("27pt").copy()
+    bad[0, 0, 0] *= 1.01
+    assert decompose_mehrstellen(bad) is None
+
+
+@pytest.mark.parametrize(
+    "bc,bc_value",
+    [
+        (BoundaryCondition.DIRICHLET, 0.0),
+        (BoundaryCondition.DIRICHLET, 1.5),
+        (BoundaryCondition.PERIODIC, 0.0),
+    ],
+)
+def test_mehrstellen_route_matches_chain(monkeypatch, bc, bc_value):
+    """HEAT3D_MEHRSTELLEN=1 switches the jnp 27pt apply to the separable
+    S+F route; same math to FMA-reordering rounding as the factored tap
+    chain, including the boundary/corner ghost cells."""
+    taps = taps_for("27pt")
+    rng = np.random.default_rng(5)
+    u = jnp.asarray(rng.standard_normal((10, 12, 16)), jnp.float32)
+    monkeypatch.delenv("HEAT3D_MEHRSTELLEN", raising=False)
+    want = step_single_device(u, taps, bc, bc_value)
+    monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+    got = step_single_device(u, taps, bc, bc_value)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-6, atol=2e-6
+    )
+    # 7pt is unaffected by the knob (no separable part): bitwise equal
+    t7 = taps_for("7pt")
+    monkeypatch.delenv("HEAT3D_MEHRSTELLEN", raising=False)
+    w7 = step_single_device(u, t7, bc, bc_value)
+    monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+    g7 = step_single_device(u, t7, bc, bc_value)
+    np.testing.assert_array_equal(np.asarray(g7), np.asarray(w7))
