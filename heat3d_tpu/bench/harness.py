@@ -77,6 +77,10 @@ def bench_throughput(
     rtt_dominated = min(raw_times) < 2 * rtt
     updates = cfg.grid.num_cells * steps
     gcells = updates / best / 1e9
+    # one consistent evaluation of the env-dependent route/selector state
+    # for all three provenance fields (each walks the real dispatch)
+    mehrstellen = _mehrstellen_route(cfg)
+    direct = _resolved_direct(cfg)
     return {
         "bench": "throughput",
         "grid": list(cfg.grid.shape),
@@ -100,14 +104,14 @@ def bench_throughput(
         # without this a HEAT3D_FACTOR_Y=0 A/B row is indistinguishable
         # from a default suite row, and analysis tools re-deriving the op
         # count later (under a different env) would mislabel it.
-        "chain_ops": _chain_ops(cfg),
-        "mehrstellen_route": _mehrstellen_route(cfg),
+        "chain_ops": _chain_ops(cfg, mehrstellen=mehrstellen),
+        "mehrstellen_route": mehrstellen,
         # Same provenance need for the transport knob: HEAT3D_NO_DIRECT=1
         # A/B rows carry identical config fields to direct rows but run
         # the exchange path at ~2x the HBM traffic — record the RESOLVED
         # selection (the real selector, not the env) so the traffic model
         # can't mislabel them.
-        "direct_path": _resolved_direct(cfg),
+        "direct_path": direct,
     }
 
 
@@ -126,48 +130,56 @@ def _resolved_direct(cfg: SolverConfig) -> bool:
     ) is not None
 
 
-def _chain_ops(cfg: SolverConfig) -> int:
+def _chain_ops(cfg: SolverConfig, mehrstellen: bool = None) -> int:
     """Vector ops/cell/update of the local compute this config runs under
     the CURRENT env: the mehrstellen separable route's canonical count
-    when that route is what executes (knob on + taps decompose + the jnp
-    apply is the resolved local compute), else the tap chain's
-    effective_num_taps. Recorded per row; scripts/roofline_check.py
-    prefers this over re-derivation."""
+    when that route is what executes (knob on + taps decompose + the
+    resolved local compute implements it — the jnp apply, or the tb=1
+    q-ring direct kernel), else the tap chain's effective_num_taps.
+    Recorded per row; scripts/roofline_check.py prefers this over
+    re-derivation. ``mehrstellen`` takes a precomputed _mehrstellen_route
+    result so one env evaluation feeds every provenance field."""
     from heat3d_tpu.core.stencils import MEHRSTELLEN_OPS, chain_ops_for
 
-    if _mehrstellen_route(cfg):
+    if mehrstellen is None:
+        mehrstellen = _mehrstellen_route(cfg)
+    if mehrstellen:
         return MEHRSTELLEN_OPS
     return chain_ops_for(cfg.stencil.kind)
 
 
 def _mehrstellen_route(cfg: SolverConfig) -> bool:
     """Whether the separable S+F route actually executes for this config:
-    knob on, taps decompose, and the local compute resolves to the jnp
-    apply (explicit --backend jnp, or auto off-TPU; kernel backends keep
-    the tap chain regardless of the knob)."""
+    knob on, taps decompose, and the local compute is one of the two
+    implementations — the jnp apply (explicit --backend jnp, or auto
+    off-TPU) or the tb=1 direct kernel (q-ring variant). The tb=2 fused
+    kernel and the windowed exchange-path kernels keep the tap chain."""
     from heat3d_tpu.core.stencils import (
-        STENCILS,
         decompose_mehrstellen,
         mehrstellen_enabled,
-        stencil_taps,
     )
+    from heat3d_tpu.parallel.step import _solver_taps
 
     if not mehrstellen_enabled():
         return False
-    taps = stencil_taps(
-        STENCILS[cfg.stencil.kind],
-        alpha=cfg.grid.alpha,
-        dt=cfg.grid.effective_dt(),
-        spacing=cfg.grid.spacing,
-    )
-    if decompose_mehrstellen(taps) is None:
+    # the solver's own tap construction, so route provenance can't diverge
+    # from what executes
+    if decompose_mehrstellen(_solver_taps(cfg)) is None:
         return False
     backend = cfg.backend
     if backend == "auto":
-        import jax
+        # the solver's own resolution (models.heat3d._select_backend):
+        # auto falls back to the jnp apply whenever the Pallas kernels
+        # can't run this config — in which case the route DOES execute
+        try:
+            from heat3d_tpu.ops.stencil_pallas import pallas_supported
 
-        backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
-    return backend == "jnp"
+            backend = "pallas" if pallas_supported(cfg)[0] else "jnp"
+        except ImportError:
+            backend = "jnp"
+    if backend == "jnp":
+        return True
+    return cfg.time_blocking == 1 and _resolved_direct(cfg)
 
 
 def bench_halo(

@@ -42,17 +42,28 @@ def apply_taps_padded(
     taps: np.ndarray,
     compute_dtype=jnp.float32,
     out_dtype=None,
+    mehrstellen: bool = None,
 ) -> jax.Array:
     """Apply 3x3x3 update taps to a ghost-padded array ``up`` of shape
     (nx+2, ny+2, nz+2); returns the (nx, ny, nz) interior update.
 
     The tap loop unrolls at trace time into shifted-slice adds; XLA fuses
     them into a single sweep (SURVEY.md §1 L1 mapping).
+
+    ``mehrstellen`` pins the route: None follows the HEAT3D_MEHRSTELLEN
+    env gate; False forces the tap chain. Callers that patch cells next
+    to a chain-route kernel (the tb=2 faces-direct shells, overlap faces
+    over a windowed-kernel interior) MUST pass False so patched and
+    bulk-computed cells share one op order (the cross-kernel ulp-match
+    contract); tb=1 faces-direct patches follow the env like their bulk
+    kernel does.
     """
     nx, ny, nz = up.shape[0] - 2, up.shape[1] - 2, up.shape[2] - 2
     out_dtype = out_dtype or up.dtype
     upc = up.astype(compute_dtype)
-    if mehrstellen_enabled():
+    if mehrstellen is None:
+        mehrstellen = mehrstellen_enabled()
+    if mehrstellen:
         coeffs = decompose_mehrstellen(taps)
         if coeffs is not None:
             return _apply_mehrstellen_padded(

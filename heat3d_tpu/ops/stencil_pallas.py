@@ -134,7 +134,8 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
         from heat3d_tpu.ops.stencil_pallas_direct import direct_supported
 
         d1 = direct_supported(
-            cfg.local_shape, 1, itemsize, itemsize, n_taps, c_item
+            cfg.local_shape, 1, itemsize, itemsize, n_taps, c_item,
+            taps=STENCILS[cfg.stencil.kind].weights,
         )
         if cfg.time_blocking == 1 and d1:
             return True, ""

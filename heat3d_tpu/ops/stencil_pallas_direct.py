@@ -50,7 +50,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from heat3d_tpu.core.stencils import effective_num_taps, flat_taps, nonzero_taps
+from heat3d_tpu.core.stencils import (
+    decompose_mehrstellen,
+    effective_num_taps,
+    flat_taps,
+    mehrstellen_enabled,
+    nonzero_taps,
+)
 
 _LANE = 128
 _SUBLANE = 8
@@ -89,21 +95,36 @@ def _tap_stack_bytes(
 
 
 def _vmem_bytes(
-    by: int, nz: int, halo: int, in_itemsize: int, out_itemsize: int
+    by: int,
+    nz: int,
+    halo: int,
+    in_itemsize: int,
+    out_itemsize: int,
+    q_itemsize: int = 0,
 ) -> int:
     """VMEM footprint of the direct kernel at chunk height ``by`` and ghost
     width ``halo`` (1 = single step, 2 = fused two-step): the assembled-plane
     ring(s), the double-buffered input chunk + ghost-row pipeline, and the
-    double-buffered output pipeline."""
+    double-buffered output pipeline. ``q_itemsize`` > 0 adds the mehrstellen
+    per-plane 2D-conv cache ring (3 planes, compute dtype)."""
     ring = 3 * _plane_bytes(by + 2 * halo, nz + 2 * halo, in_itemsize)
     if halo == 2:  # fused two-step: second ring for the intermediate planes
         ring += 3 * _plane_bytes(by + 2, nz + 2, in_itemsize)
+    if q_itemsize:
+        ring += 3 * _plane_bytes(by, nz, q_itemsize)
     pipe_in = 2 * (
         _plane_bytes(by, nz, in_itemsize)
         + 2 * halo * _plane_bytes(1, nz, in_itemsize)
     )
     pipe_out = 2 * _plane_bytes(by, nz, out_itemsize)
     return ring + pipe_in + pipe_out
+
+
+# Scoped-stack planes of the mehrstellen emit/store (vs the tap chain's
+# effective_num_taps): store-time z131 + q (2), emit-time s + the psum
+# accumulation (<=3 live) + u0 + the result accumulator (~6 peak). Used
+# for the chunk chooser's stack budgeting whenever the q-ring route runs.
+_MEHRSTELLEN_STACK_PLANES = 8
 
 
 def choose_chunk(
@@ -113,10 +134,16 @@ def choose_chunk(
     out_itemsize: int = 4,
     n_taps: int = 7,
     compute_itemsize: int = 4,
+    q_ring: bool = False,
 ) -> Optional[int]:
     """Largest y-chunk height ``by`` (a divisor of ny, multiple of 8 when
     ny >= 8) whose working set fits the VMEM budget — both the explicit
-    ring/pipeline buffers and the tap chain's scoped stack — or None."""
+    ring/pipeline buffers (including the mehrstellen q-ring when
+    ``q_ring``) and the emit chain's scoped stack — or None. ``q_ring``
+    overrides ``n_taps`` with the mehrstellen stack size here, in ONE
+    place, so the dispatch gate and the kernel builder can't drift."""
+    if q_ring:
+        n_taps = _MEHRSTELLEN_STACK_PLANES
     ny, nz = local_shape[1], local_shape[2]
     for by in range(ny, 0, -1):
         if ny % by:
@@ -127,13 +154,28 @@ def choose_chunk(
             # unaligned
             continue
         if (
-            _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize)
+            _vmem_bytes(
+                by, nz, halo, in_itemsize, out_itemsize,
+                q_itemsize=compute_itemsize if q_ring else 0,
+            )
             <= _VMEM_BUDGET
             and _tap_stack_bytes(by, nz, halo, n_taps, compute_itemsize)
             <= _TAP_STACK_BUDGET
         ):
             return by
     return None
+
+
+def _mehrstellen_q_ring(taps) -> bool:
+    """Whether apply_taps_direct will take the q-ring mehrstellen route
+    for these taps under the current env — the ONE predicate the dispatch
+    gate (direct_supported) and the kernel builder must share, so the
+    gate can never approve a shape the builder then rejects."""
+    return (
+        taps is not None
+        and mehrstellen_enabled()
+        and decompose_mehrstellen(taps) is not None
+    )
 
 
 def direct_supported(
@@ -143,14 +185,19 @@ def direct_supported(
     out_itemsize: int = 4,
     n_taps: int = 7,
     compute_itemsize: int = 4,
+    taps=None,
 ) -> bool:
+    """Pass ``taps`` so the gate budgets the same route (q-ring or chain)
+    apply_taps_direct will actually build; without them the chain route
+    is assumed (the mehrstellen knob is ignored)."""
     nx, ny, nz = local_shape
     if halo == 2 and (nx < 2 or ny < 2 or nz < 2):
         return False  # wrapped/clamped width-2 ghosts would alias interior
+    q_ring = halo == 1 and _mehrstellen_q_ring(taps)
     return (
         choose_chunk(
             local_shape, halo, in_itemsize, out_itemsize, n_taps,
-            compute_itemsize,
+            compute_itemsize, q_ring=q_ring,
         )
         is not None
     )
@@ -250,8 +297,10 @@ def _direct_kernel(
     bot_ref,
     out_ref,
     ring,
+    ring_q=None,
     *,
-    taps_flat,
+    taps_flat=None,
+    coeffs=None,
     nx,
     by,
     nz,
@@ -264,7 +313,14 @@ def _direct_kernel(
     """Grid step (j, i): assemble ghost-framed plane p = i-1 of chunk column
     j into a 3-slot ring; once 3 planes are resident emit output plane i-2.
     Conceptual plane p runs -1 .. nx (the two x ghost planes); the index maps
-    wrap (periodic) or clamp (Dirichlet, substituted with bc here)."""
+    wrap (periodic) or clamp (Dirichlet, substituted with bc here).
+
+    Two emit routes over one scaffold (the ring-slot arithmetic and ghost
+    synthesis are load-bearing invariants kept in exactly one place):
+    ``taps_flat`` = the canonical tap chain; ``coeffs`` + ``ring_q`` = the
+    mehrstellen S+F route, where each stored plane also caches its 2D conv
+    in ``ring_q`` (computed ONCE per input plane instead of once per output
+    plane that reads it — the shifted-read reuse the route exists for)."""
     j = pl.program_id(0)
     i = pl.program_id(1)
     bc = u_ref.dtype.type(bc_value)
@@ -287,25 +343,64 @@ def _direct_kernel(
                 ring, k, chunk, top, bot, bc, periodic, 1,
                 ghost_x=jnp.logical_or(i == 0, i == nx + 1),
             )
+            if coeffs is not None:
+                # AFTER the framed store (sequential ref semantics: reads
+                # back the exact stored frame)
+                ring_q[k] = _plane_q(ring[k], by, nz, compute_dtype)
 
     for k in range(3):
 
         @pl.when(jnp.logical_and(i >= 2, jax.lax.rem(i, 3) == k))
         def _emit(k=k):
             # planes (i-2, i-1, i) live in slots ((k+1)%3, (k+2)%3, k)
+            slots = {-1: (k + 1) % 3, 0: (k + 2) % 3, 1: k}
             planes = {
-                -1: ring[(k + 1) % 3].astype(compute_dtype),
-                0: ring[(k + 2) % 3].astype(compute_dtype),
-                1: ring[k].astype(compute_dtype),
+                d: ring[s].astype(compute_dtype) for d, s in slots.items()
             }
-            out_ref[0] = _plane_taps(
-                planes, taps_flat, by, nz, compute_dtype
-            ).astype(out_dtype)
+            if coeffs is not None:
+                q_planes = {d: ring_q[s] for d, s in slots.items()}
+                res = _plane_mehrstellen(
+                    planes, q_planes, coeffs, by, nz, compute_dtype
+                )
+            else:
+                res = _plane_taps(planes, taps_flat, by, nz, compute_dtype)
+            out_ref[0] = res.astype(out_dtype)
 
 
-def _direct_kernel_single(u_ref, out_ref, ring, **params):
+def _direct_kernel_single(u_ref, out_ref, ring, ring_q=None, **params):
     """Single-chunk-column variant: no ghost-row refs (derived in-kernel)."""
-    _direct_kernel(u_ref, None, None, out_ref, ring, **params)
+    _direct_kernel(u_ref, None, None, out_ref, ring, ring_q, **params)
+
+
+def _plane_q(framed, by, nz, compute_dtype):
+    """Per-plane mehrstellen cache: the 2D [1,3,1](x)[1,3,1] convolution of
+    one ghost-framed (by+2, nz+2) plane, valid interior (by, nz). Op order
+    is the z-then-y prefix of the canonical mehrstellen order
+    (ops.stencil_jnp._apply_mehrstellen_padded)."""
+    f = framed.astype(compute_dtype)
+    three = compute_dtype(3.0)
+    z131 = (f[:, 0:nz] + f[:, 2 : nz + 2]) + three * f[:, 1 : nz + 1]
+    return (z131[0:by] + z131[2 : by + 2]) + three * z131[1 : by + 1]
+
+
+def _plane_mehrstellen(planes, q_planes, coeffs, by, nz, compute_dtype):
+    """Emit one output plane from the 3 framed x-planes and their cached
+    q planes: S via the x-direction [1,3,1] over the q ring, the face sum
+    from the framed planes, one 3-term combine — the canonical mehrstellen
+    order's x/psum/combine suffix."""
+    a, b, d = (compute_dtype(c) for c in coeffs)
+    three = compute_dtype(3.0)
+    s = (q_planes[-1] + q_planes[1]) + three * q_planes[0]
+    f0 = planes[0]
+    u0 = f0[1 : 1 + by, 1 : 1 + nz]
+    px = (
+        planes[-1][1 : 1 + by, 1 : 1 + nz]
+        + planes[1][1 : 1 + by, 1 : 1 + nz]
+    )
+    py = f0[0:by, 1 : 1 + nz] + f0[2 : by + 2, 1 : 1 + nz]
+    pz = f0[1 : 1 + by, 0:nz] + f0[1 : 1 + by, 2 : nz + 2]
+    psum = (px + py) + pz
+    return (a * u0 + b * s) + d * psum
 
 
 def apply_taps_direct(
@@ -325,10 +420,13 @@ def apply_taps_direct(
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
     flat = flat_taps(taps)
+    q_ring = _mehrstellen_q_ring(taps)
+    coeffs = decompose_mehrstellen(taps) if q_ring else None
     by = choose_chunk(
         u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
         n_taps=effective_num_taps(taps),
         compute_itemsize=jnp.dtype(compute_dtype).itemsize,
+        q_ring=q_ring,
     )
     if by is None:
         raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
@@ -340,9 +438,8 @@ def apply_taps_direct(
         x_of = lambda i: jnp.clip(i - 1, 0, nx - 1)
 
     single = n_chunks == 1
-    kernel = functools.partial(
-        _direct_kernel if not single else _direct_kernel_single,
-        taps_flat=flat,
+    scratch_shapes = [pltpu.VMEM((3, by + 2, nz + 2), u.dtype)]
+    shared = dict(
         nx=nx,
         by=by,
         nz=nz,
@@ -352,12 +449,24 @@ def apply_taps_direct(
         compute_dtype=compute_dtype,
         out_dtype=jnp.dtype(out_dtype),
     )
+    base = _direct_kernel if not single else _direct_kernel_single
+    if coeffs is not None:
+        kernel = functools.partial(base, coeffs=coeffs, **shared)
+        scratch_shapes.append(
+            pltpu.VMEM((3, by, nz), jnp.dtype(compute_dtype))
+        )
+    else:
+        kernel = functools.partial(base, taps_flat=flat, **shared)
     in_specs = [pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0))]
     operands = (u,)
     if not single:
         in_specs += _row_block_specs(x_of, by, ny, nz, periodic)
         operands = (u, u, u)
-    flops_per_cell = 2 * len(flat)
+    # the mehrstellen route does ~MEHRSTELLEN_OPS vector ops/cell, not the
+    # chain's len(flat) — the estimate feeds XLA's overlap scheduling
+    from heat3d_tpu.core.stencils import MEHRSTELLEN_OPS
+
+    flops_per_cell = 2 * (MEHRSTELLEN_OPS if coeffs is not None else len(flat))
     return pl.pallas_call(
         kernel,
         grid=(n_chunks, nx + 2),
@@ -366,7 +475,7 @@ def apply_taps_direct(
             (1, by, nz), lambda j, i: (jnp.maximum(i - 2, 0), j, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
-        scratch_shapes=[pltpu.VMEM((3, by + 2, nz + 2), u.dtype)],
+        scratch_shapes=scratch_shapes,
         cost_estimate=pl.CostEstimate(
             flops=flops_per_cell * nx * ny * nz,
             bytes_accessed=nx * ny * nz

@@ -220,8 +220,11 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
     itemsize = jnp.dtype(cfg.precision.storage).itemsize
     n_taps = effective_num_taps(STENCILS[cfg.stencil.kind].weights)
     c_item = jnp.dtype(cfg.precision.compute).itemsize
+    # taps: weights suffice for the gate's mehrstellen predicate — the
+    # update taps T = I + c*W decompose iff W does (affine in the center)
     if not direct_supported(
-        cfg.local_shape, halo, itemsize, itemsize, n_taps, c_item
+        cfg.local_shape, halo, itemsize, itemsize, n_taps, c_item,
+        taps=STENCILS[cfg.stencil.kind].weights,
     ):
         return None
     import functools
@@ -397,13 +400,18 @@ def _local_superstep_direct_faces(
             continue  # kernel's local BC/wrap is already exact on this axis
         n = u_local.shape[axis]
         for start in (0, n - 2):  # width-2 padded coords; final planes
+            # mehrstellen=False: the direct2 bulk kernel runs the tap
+            # chain regardless of the knob, and patched cells must share
+            # its op order (cross-kernel ulp-match contract)
             slab = _padded_slab(u_local, faces, axis, start, w=2, thickness=6)
             mid = apply_taps_padded(
-                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
+                mehrstellen=False,
             )
             mid = _pin_slab_mid(mid, cfg, axis, start)
             shell = apply_taps_padded(
-                mid, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+                mid, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
+                mehrstellen=False,
             )
             idx = [0, 0, 0]
             idx[axis] = start  # local planes [start, start+2)
@@ -447,12 +455,16 @@ def _local_step_overlap(
     # Boundary shell: six thickness-1 faces from the ghost-padded block.
     # Edge/corner cells land in two or three face slabs; each computes the
     # identical value, so overlapping writes are benign. Faces are thin VPU
-    # work — always the jnp path, even when the interior runs Pallas.
+    # work — always the jnp path, even when the interior runs Pallas; the
+    # route must then match the interior's (a windowed-kernel interior
+    # runs the tap chain, so its faces pin mehrstellen=False).
+    face_mehrstellen = None if compute_padded is apply_taps_padded else False
     for axis, n in enumerate((nx, ny, nz)):
         for start, pos in ((0, 0), (n - 1, n - 1)):
             slab = lax.slice_in_dim(up, start, start + 3, axis=axis)
             face = apply_taps_padded(
-                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
+                mehrstellen=face_mehrstellen,
             )
             idx = [0, 0, 0]
             idx[axis] = pos

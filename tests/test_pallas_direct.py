@@ -263,3 +263,70 @@ def test_direct_kernels_cross_lower_for_tpu(monkeypatch):
                     lambda v, f=fn, p=periodic: f(v, taps, periodic=p, bc_value=0.5)
                 ).trace(u).lower(lowering_platforms=("tpu",))
                 assert "tpu_custom_call" in low.as_text(), (by, periodic, fn)
+        # mehrstellen q-ring variant of the tb=1 kernel
+        monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+        for periodic in (False, True):
+            low = jax.jit(
+                lambda v, p=periodic: d.apply_taps_direct(
+                    v, taps, periodic=p, bc_value=0.5
+                )
+            ).trace(u).lower(lowering_platforms=("tpu",))
+            assert "tpu_custom_call" in low.as_text(), (by, periodic, "mehr")
+        monkeypatch.delenv("HEAT3D_MEHRSTELLEN")
+
+
+@pytest.mark.parametrize("shape", [(8, 16, 32), (5, 16, 128)])
+def test_direct_mehrstellen_interpret_matches_chain(shape, monkeypatch):
+    """HEAT3D_MEHRSTELLEN=1 routes the tb=1 direct kernel through the
+    q-ring S+F variant: same math as the tap chain to FMA-reordering
+    rounding, and bitwise-equal to the jnp mehrstellen apply's op order
+    contract (both implement the canonical order)."""
+    u = jnp.asarray(golden.random_init(shape, seed=3))
+    taps = _taps("27pt", shape)
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        monkeypatch.delenv("HEAT3D_MEHRSTELLEN", raising=False)
+        chain = apply_taps_direct(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+        got = apply_taps_direct(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(chain), rtol=3e-6, atol=3e-6,
+            err_msg=f"mehrstellen vs chain bc={bc} bcv={bcv}",
+        )
+        want = step_single_device(u, taps, bc, bcv)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-6, atol=3e-6,
+            err_msg=f"mehrstellen kernel vs jnp-mehrstellen bc={bc}",
+        )
+
+
+def test_direct_mehrstellen_multichunk_interpret(monkeypatch):
+    """Chunked-column mode (by < ny): the per-chunk q planes are built
+    from framed planes whose ghost rows carry real neighbor data, so the
+    2D convs match the global jnp result across chunk borders."""
+    from heat3d_tpu.ops import stencil_pallas_direct as d
+
+    shape = (6, 32, 16)
+    u = jnp.asarray(golden.random_init(shape, seed=4))
+    taps = _taps("27pt", shape)
+    monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+    # force multi-chunk: shrink the VMEM budget so by=8 chunks are chosen
+    monkeypatch.setattr(d, "_VMEM_BUDGET", 120 * 1024)
+    by = d.choose_chunk(shape, 1, 4, 4, n_taps=15, q_ring=True)
+    assert by is not None and by < shape[1], by
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        got = apply_taps_direct(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        monkeypatch.delenv("HEAT3D_MEHRSTELLEN", raising=False)
+        want = step_single_device(u, taps, bc, bcv)
+        monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-6, atol=3e-6,
+            err_msg=f"multichunk mehrstellen bc={bc}",
+        )
