@@ -134,8 +134,9 @@ def _chain_ops(cfg: SolverConfig, mehrstellen: bool = None) -> int:
     """Vector ops/cell/update of the local compute this config runs under
     the CURRENT env: the mehrstellen separable route's canonical count
     when that route is what executes (knob on + taps decompose + the
-    resolved local compute implements it — the jnp apply, or the tb=1
-    q-ring direct kernel), else the tap chain's effective_num_taps.
+    resolved local compute implements it — the jnp apply, or the q-ring
+    direct kernels at tb=1/tb=2), else the tap chain's
+    effective_num_taps.
     Recorded per row; scripts/roofline_check.py prefers this over
     re-derivation. ``mehrstellen`` takes a precomputed _mehrstellen_route
     result so one env evaluation feeds every provenance field."""
@@ -150,10 +151,10 @@ def _chain_ops(cfg: SolverConfig, mehrstellen: bool = None) -> int:
 
 def _mehrstellen_route(cfg: SolverConfig) -> bool:
     """Whether the separable S+F route actually executes for this config:
-    knob on, taps decompose, and the local compute is one of the two
-    implementations — the jnp apply (explicit --backend jnp, or auto
-    off-TPU) or the tb=1 direct kernel (q-ring variant). The tb=2 fused
-    kernel and the windowed exchange-path kernels keep the tap chain."""
+    knob on, taps decompose, and the local compute implements it — the
+    jnp apply (explicit --backend jnp, or auto off-TPU) or the q-ring
+    direct kernels (tb=1 single step, tb=2 fused superstep). The windowed
+    exchange-path kernels keep the tap chain."""
     from heat3d_tpu.core.stencils import (
         decompose_mehrstellen,
         mehrstellen_enabled,
@@ -179,7 +180,7 @@ def _mehrstellen_route(cfg: SolverConfig) -> bool:
             backend = "jnp"
     if backend == "jnp":
         return True
-    return cfg.time_blocking == 1 and _resolved_direct(cfg)
+    return cfg.time_blocking in (1, 2) and _resolved_direct(cfg)
 
 
 def bench_halo(

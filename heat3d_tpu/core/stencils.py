@@ -288,13 +288,13 @@ MEHRSTELLEN_OPS = 14
 def mehrstellen_enabled() -> bool:
     """HEAT3D_MEHRSTELLEN (same convention as the sibling factoring knobs:
     unset/'0'/'false' = off) switches eligible stencils (today: the 27pt
-    set) to the separable S+F route, implemented in the jnp apply AND the
-    tb=1 direct kernel (whose q-ring caches each plane's 2D conv once —
-    the shifted-read reuse the route exists for; faces-direct shell
-    patches then match the bulk's route). The tb=2 fused kernel and the
-    windowed exchange-path kernels keep the tap chain. Default OFF until
-    the on-chip A/B lands — the committed measured record runs the
-    factored tap chain."""
+    set) to the separable S+F route, implemented in the jnp apply and the
+    tb=1/tb=2 direct kernels (whose q-rings cache each plane's 2D conv
+    once per stage — the shifted-read reuse the route exists for;
+    faces-direct shell patches then match the bulk's route). The windowed
+    exchange-path kernels keep the tap chain (their interiors pin their
+    jnp faces to the chain). Default OFF until the on-chip A/B lands —
+    the committed measured record runs the factored tap chain."""
     import os
 
     return os.environ.get("HEAT3D_MEHRSTELLEN", "").lower() not in (

@@ -143,7 +143,8 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
             cfg.time_blocking == 2
             and d1
             and direct_supported(
-                cfg.local_shape, 2, itemsize, itemsize, n_taps, c_item
+                cfg.local_shape, 2, itemsize, itemsize, n_taps, c_item,
+                taps=STENCILS[cfg.stencil.kind].weights,
             )
         ):
             return True, ""

@@ -111,7 +111,11 @@ def _vmem_bytes(
     if halo == 2:  # fused two-step: second ring for the intermediate planes
         ring += 3 * _plane_bytes(by + 2, nz + 2, in_itemsize)
     if q_itemsize:
+        # one q ring per update stage: (by, nz) for the final stage, plus
+        # the (by+2, nz+2) first-stage ring under temporal blocking
         ring += 3 * _plane_bytes(by, nz, q_itemsize)
+        if halo == 2:
+            ring += 3 * _plane_bytes(by + 2, nz + 2, q_itemsize)
     pipe_in = 2 * (
         _plane_bytes(by, nz, in_itemsize)
         + 2 * halo * _plane_bytes(1, nz, in_itemsize)
@@ -193,7 +197,7 @@ def direct_supported(
     nx, ny, nz = local_shape
     if halo == 2 and (nx < 2 or ny < 2 or nz < 2):
         return False  # wrapped/clamped width-2 ghosts would alias interior
-    q_ring = halo == 1 and _mehrstellen_q_ring(taps)
+    q_ring = _mehrstellen_q_ring(taps)
     return (
         choose_chunk(
             local_shape, halo, in_itemsize, out_itemsize, n_taps,
@@ -493,8 +497,11 @@ def _direct2_kernel(
     out_ref,
     ring_a,
     ring_b,
+    ring_qa=None,
+    ring_qb=None,
     *,
-    taps_flat,
+    taps_flat=None,
+    coeffs=None,
     nx,
     by,
     nz,
@@ -513,7 +520,13 @@ def _direct2_kernel(
     sees them; (c) at i>=4 emit output plane o = i-4 (global). Same plane
     indexing as ops.stencil_pallas._stream2_kernel; only the input source
     (assembled vs pre-padded) differs. Chunk columns recompute their two
-    boundary intermediate rows — ~2/by duplicated VPU work, no extra HBM."""
+    boundary intermediate rows — ~2/by duplicated VPU work, no extra HBM.
+
+    Routes as in _direct_kernel: ``taps_flat`` = tap chain;
+    ``coeffs`` + ``ring_qa``/``ring_qb`` = mehrstellen, with a per-stage
+    q cache (each stored input/intermediate plane's 2D conv computed once;
+    stage (b)'s cache is built AFTER the ghost pinning so it convolves
+    exactly the plane the unfused sequence would read)."""
     j = pl.program_id(0)
     i = pl.program_id(1)
     bc_s = u_ref.dtype.type(bc_value)
@@ -532,24 +545,35 @@ def _direct2_kernel(
                 ring_a, k, chunk, top, bot, bc_s, periodic, 2,
                 ghost_x=jnp.logical_or(i <= 1, i >= nx + 2),
             )
+            if coeffs is not None:
+                ring_qa[k] = _plane_q(ring_a[k], by + 2, nz + 2, compute_dtype)
 
     # (b) intermediate plane m = i-2 from input planes (i-2, i-1, i).
     for k in range(3):  # k == i % 3
 
         @pl.when(jnp.logical_and(i >= 2, jax.lax.rem(i, 3) == k))
         def _mid(k=k):
+            slots = {-1: (k + 1) % 3, 0: (k + 2) % 3, 1: k}
             planes = {
-                -1: ring_a[(k + 1) % 3].astype(compute_dtype),
-                0: ring_a[(k + 2) % 3].astype(compute_dtype),
-                1: ring_a[k].astype(compute_dtype),
+                d: ring_a[s].astype(compute_dtype) for d, s in slots.items()
             }
-            mid = _plane_taps(
-                planes, taps_flat, by + 2, nz + 2, compute_dtype
-            )
+            if coeffs is not None:
+                q_planes = {d: ring_qa[s] for d, s in slots.items()}
+                mid = _plane_mehrstellen(
+                    planes, q_planes, coeffs, by + 2, nz + 2, compute_dtype
+                )
+            else:
+                mid = _plane_taps(
+                    planes, taps_flat, by + 2, nz + 2, compute_dtype
+                )
             slot = (k + 1) % 3  # slot (i-2)%3
             if periodic:
                 # round-trip through storage dtype so fused == unfused bitwise
                 ring_b[slot] = mid.astype(storage_dtype)
+                if coeffs is not None:
+                    ring_qb[slot] = _plane_q(
+                        ring_b[slot], by, nz, compute_dtype
+                    )
             else:
                 m = i - 2  # 0 .. nx+1 in 1-ring coords; 0 / nx+1 = ghosts
                 ghost_plane = jnp.logical_or(m == 0, m == nx + 1)
@@ -581,24 +605,40 @@ def _direct2_kernel(
                     def _bot_row():
                         ring_b[slot, by + 1 : by + 2, :] = edge_row
 
+                if coeffs is not None:
+                    # after BOTH branches' stores: convolve the exact
+                    # (pinned or pure-bc) plane stage (c) will read
+                    ring_qb[slot] = _plane_q(
+                        ring_b[slot], by, nz, compute_dtype
+                    )
+
     # (c) output plane o = i-4 from intermediate planes (i-4, i-3, i-2).
     for k in range(3):  # k == i % 3; (i-4)%3 == (k+2)%3, (i-3)%3 == k
 
         @pl.when(jnp.logical_and(i >= 4, jax.lax.rem(i, 3) == k))
         def _out(k=k):
+            slots = {-1: (k + 2) % 3, 0: k, 1: (k + 1) % 3}
             planes = {
-                -1: ring_b[(k + 2) % 3].astype(compute_dtype),
-                0: ring_b[k].astype(compute_dtype),
-                1: ring_b[(k + 1) % 3].astype(compute_dtype),
+                d: ring_b[s].astype(compute_dtype) for d, s in slots.items()
             }
-            out_ref[0] = _plane_taps(
-                planes, taps_flat, by, nz, compute_dtype
-            ).astype(out_dtype)
+            if coeffs is not None:
+                q_planes = {d: ring_qb[s] for d, s in slots.items()}
+                res = _plane_mehrstellen(
+                    planes, q_planes, coeffs, by, nz, compute_dtype
+                )
+            else:
+                res = _plane_taps(planes, taps_flat, by, nz, compute_dtype)
+            out_ref[0] = res.astype(out_dtype)
 
 
-def _direct2_kernel_single(u_ref, out_ref, ring_a, ring_b, **params):
+def _direct2_kernel_single(
+    u_ref, out_ref, ring_a, ring_b, ring_qa=None, ring_qb=None, **params
+):
     """Single-chunk-column variant: no ghost-row refs (derived in-kernel)."""
-    _direct2_kernel(u_ref, None, None, out_ref, ring_a, ring_b, **params)
+    _direct2_kernel(
+        u_ref, None, None, out_ref, ring_a, ring_b, ring_qa, ring_qb,
+        **params,
+    )
 
 
 def apply_taps_direct2(
@@ -618,10 +658,13 @@ def apply_taps_direct2(
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
     flat = flat_taps(taps)
+    q_ring = _mehrstellen_q_ring(taps)
+    coeffs = decompose_mehrstellen(taps) if q_ring else None
     by = choose_chunk(
         u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
         n_taps=effective_num_taps(taps),
         compute_itemsize=jnp.dtype(compute_dtype).itemsize,
+        q_ring=q_ring,
     )
     if by is None:
         raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
@@ -633,9 +676,8 @@ def apply_taps_direct2(
         x_of = lambda i: jnp.clip(i - 2, 0, nx - 1)
 
     single = n_chunks == 1
-    kernel = functools.partial(
-        _direct2_kernel if not single else _direct2_kernel_single,
-        taps_flat=flat,
+    base = _direct2_kernel if not single else _direct2_kernel_single
+    shared = dict(
         nx=nx,
         by=by,
         nz=nz,
@@ -646,12 +688,28 @@ def apply_taps_direct2(
         storage_dtype=u.dtype,
         out_dtype=jnp.dtype(out_dtype),
     )
+    scratch_shapes = [
+        pltpu.VMEM((3, by + 4, nz + 4), u.dtype),
+        pltpu.VMEM((3, by + 2, nz + 2), u.dtype),
+    ]
+    if coeffs is not None:
+        kernel = functools.partial(base, coeffs=coeffs, **shared)
+        scratch_shapes += [
+            pltpu.VMEM((3, by + 2, nz + 2), jnp.dtype(compute_dtype)),
+            pltpu.VMEM((3, by, nz), jnp.dtype(compute_dtype)),
+        ]
+    else:
+        kernel = functools.partial(base, taps_flat=flat, **shared)
     in_specs = [pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0))]
     operands = (u,)
     if not single:
         in_specs += _row_block_specs(x_of, by, ny, nz, periodic)
         operands = (u, u, u)
-    flops_per_cell = 2 * 2 * len(flat)
+    from heat3d_tpu.core.stencils import MEHRSTELLEN_OPS
+
+    flops_per_cell = 2 * 2 * (
+        MEHRSTELLEN_OPS if coeffs is not None else len(flat)
+    )
     return pl.pallas_call(
         kernel,
         grid=(n_chunks, nx + 4),
@@ -660,10 +718,7 @@ def apply_taps_direct2(
             (1, by, nz), lambda j, i: (jnp.maximum(i - 4, 0), j, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
-        scratch_shapes=[
-            pltpu.VMEM((3, by + 4, nz + 4), u.dtype),
-            pltpu.VMEM((3, by + 2, nz + 2), u.dtype),
-        ],
+        scratch_shapes=scratch_shapes,
         cost_estimate=pl.CostEstimate(
             flops=flops_per_cell * nx * ny * nz,
             bytes_accessed=nx * ny * nz

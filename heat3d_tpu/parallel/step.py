@@ -400,18 +400,16 @@ def _local_superstep_direct_faces(
             continue  # kernel's local BC/wrap is already exact on this axis
         n = u_local.shape[axis]
         for start in (0, n - 2):  # width-2 padded coords; final planes
-            # mehrstellen=False: the direct2 bulk kernel runs the tap
-            # chain regardless of the knob, and patched cells must share
-            # its op order (cross-kernel ulp-match contract)
+            # env-default route: the direct2 bulk kernel follows the
+            # mehrstellen knob (q-ring variant), so patched cells follow
+            # it too (cross-kernel ulp-match contract)
             slab = _padded_slab(u_local, faces, axis, start, w=2, thickness=6)
             mid = apply_taps_padded(
-                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
-                mehrstellen=False,
+                slab, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
             )
             mid = _pin_slab_mid(mid, cfg, axis, start)
             shell = apply_taps_padded(
-                mid, taps, compute_dtype=compute_dtype, out_dtype=out_dtype,
-                mehrstellen=False,
+                mid, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
             )
             idx = [0, 0, 0]
             idx[axis] = start  # local planes [start, start+2)

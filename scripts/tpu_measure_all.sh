@@ -89,15 +89,17 @@ for dt in "bf16 fp32" "bf16 bf16" "fp32 bf16"; do
   echo "storage=$st compute=$cd: $out" | tee -a "$LOG"
 done
 
-echo "--- stage 3e: 27pt mehrstellen A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
-# separable S+F route (q-ring direct kernel) vs the factored tap chain;
+echo "--- stage 3e: 27pt mehrstellen A/B (512^3 fp32, tb=1 and tb=2)" | tee -a "$LOG"
+# separable S+F route (q-ring direct kernels) vs the factored tap chain;
 # chain_ops/mehrstellen_route in each row pin which route ran
 for mh in 0 1; do
-  wait_tpu "mehrstellen A/B $mh" || continue
-  out=$(env HEAT3D_MEHRSTELLEN=$mh timeout 1200 python -m heat3d_tpu.bench \
-    --grid 512 --steps 50 --stencil 27pt --time-blocking 1 \
-    --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
-  echo "mehrstellen=$mh: $out" | tee -a "$LOG"
+  for tb in 1 2; do
+    wait_tpu "mehrstellen A/B mh=$mh tb=$tb" || continue
+    out=$(env HEAT3D_MEHRSTELLEN=$mh timeout 1200 python -m heat3d_tpu.bench \
+      --grid 512 --steps 50 --stencil 27pt --time-blocking $tb \
+      --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
+    echo "mehrstellen=$mh tb=$tb: $out" | tee -a "$LOG"
+  done
 done
 
 echo "--- stage 4: profile traces" | tee -a "$LOG"

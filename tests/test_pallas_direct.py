@@ -263,15 +263,18 @@ def test_direct_kernels_cross_lower_for_tpu(monkeypatch):
                     lambda v, f=fn, p=periodic: f(v, taps, periodic=p, bc_value=0.5)
                 ).trace(u).lower(lowering_platforms=("tpu",))
                 assert "tpu_custom_call" in low.as_text(), (by, periodic, fn)
-        # mehrstellen q-ring variant of the tb=1 kernel
+        # mehrstellen q-ring variants (tb=1 and the fused tb=2 kernel)
         monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
         for periodic in (False, True):
-            low = jax.jit(
-                lambda v, p=periodic: d.apply_taps_direct(
-                    v, taps, periodic=p, bc_value=0.5
+            for fn in (d.apply_taps_direct, d.apply_taps_direct2):
+                low = jax.jit(
+                    lambda v, f=fn, p=periodic: f(
+                        v, taps, periodic=p, bc_value=0.5
+                    )
+                ).trace(u).lower(lowering_platforms=("tpu",))
+                assert "tpu_custom_call" in low.as_text(), (
+                    by, periodic, fn, "mehr",
                 )
-            ).trace(u).lower(lowering_platforms=("tpu",))
-            assert "tpu_custom_call" in low.as_text(), (by, periodic, "mehr")
         monkeypatch.delenv("HEAT3D_MEHRSTELLEN")
 
 
@@ -329,4 +332,53 @@ def test_direct_mehrstellen_multichunk_interpret(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=3e-6, atol=3e-6,
             err_msg=f"multichunk mehrstellen bc={bc}",
+        )
+
+
+@pytest.mark.parametrize("shape", [(8, 16, 32), (6, 16, 128)])
+def test_direct2_mehrstellen_interpret_matches_two_steps(shape, monkeypatch):
+    """tb=2 q-ring route: the fused two-update kernel under
+    HEAT3D_MEHRSTELLEN=1 equals two jnp mehrstellen steps (the storage
+    round-trip between updates is preserved), both BCs."""
+    u = jnp.asarray(golden.random_init(shape, seed=6))
+    taps = _taps("27pt", shape)
+    monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        got = apply_taps_direct2(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        want = step_single_device(
+            step_single_device(u, taps, bc, bcv), taps, bc, bcv
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-6, atol=3e-6,
+            err_msg=f"direct2 mehrstellen bc={bc} bcv={bcv}",
+        )
+
+
+def test_direct2_mehrstellen_multichunk_interpret(monkeypatch):
+    """tb=2 q-ring route in chunked-column mode: stage (b)'s per-chunk
+    edge-row pinning must land BEFORE its ring_qb build, so the cached
+    conv matches the pinned plane across chunk borders."""
+    from heat3d_tpu.ops import stencil_pallas_direct as d
+
+    shape = (6, 32, 16)
+    u = jnp.asarray(golden.random_init(shape, seed=7))
+    taps = _taps("27pt", shape)
+    monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
+    monkeypatch.setattr(d, "_VMEM_BUDGET", 150 * 1024)
+    by = d.choose_chunk(shape, 2, 4, 4, q_ring=True)
+    assert by is not None and by < shape[1], by
+    for bc, bcv in CASES:
+        periodic = bc is BoundaryCondition.PERIODIC
+        got = apply_taps_direct2(
+            u, taps, periodic=periodic, bc_value=bcv, interpret=True
+        )
+        want = step_single_device(
+            step_single_device(u, taps, bc, bcv), taps, bc, bcv
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-6, atol=3e-6,
+            err_msg=f"multichunk direct2 mehrstellen bc={bc}",
         )
