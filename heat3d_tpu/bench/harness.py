@@ -83,6 +83,10 @@ def bench_throughput(
     direct = _resolved_direct(cfg)
     return {
         "bench": "throughput",
+        # platform provenance: bench_results.jsonl is the on-chip record
+        # by convention, but only this field makes a stray CPU row
+        # detectable (bench.py's fallback filters on it)
+        "platform": jax.default_backend(),
         "grid": list(cfg.grid.shape),
         "stencil": cfg.stencil.kind,
         "mesh": list(cfg.mesh.shape),
@@ -278,6 +282,7 @@ def bench_halo(
     bytes_per_dev = 2 * face_cells * jnp.dtype(cfg.precision.storage).itemsize
     return {
         "bench": "halo",
+        "platform": jax.default_backend(),
         "grid": list(cfg.grid.shape),
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,

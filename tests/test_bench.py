@@ -258,3 +258,68 @@ def test_chain_ops_tracks_mehrstellen_route(monkeypatch):
     # 7pt has no separable part
     cfg7 = SolverConfig(grid=GridConfig.cube(8), backend="jnp")
     assert _chain_ops(cfg7) == 7
+
+
+def test_best_committed_tpu_record_filters(tmp_path):
+    """The CPU-fallback line attaches the best committed ON-CHIP 7pt row:
+    cpu-platform, RTT-dominated, small-grid, and non-7pt rows are
+    excluded; legacy rows without a platform field count as on-chip."""
+    import importlib.util, os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_root", os.path.join(root, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    rows = [
+        {"bench": "throughput", "stencil": "7pt", "grid": [1024] * 3,
+         "dtype": "float32", "time_blocking": 2,
+         "gcell_per_sec_per_chip": 103.1},                      # legacy: keep
+        {"bench": "throughput", "stencil": "7pt", "grid": [1024] * 3,
+         "platform": "cpu", "dtype": "float32",
+         "gcell_per_sec_per_chip": 999.0},                      # cpu: drop
+        {"bench": "throughput", "stencil": "7pt", "grid": [256] * 3,
+         "platform": "tpu", "dtype": "float32",
+         "gcell_per_sec_per_chip": 500.0},                      # small: drop
+        {"bench": "throughput", "stencil": "27pt", "grid": [1024] * 3,
+         "platform": "tpu", "dtype": "float32",
+         "gcell_per_sec_per_chip": 400.0},                      # 27pt: drop
+        {"bench": "throughput", "stencil": "7pt", "grid": [512] * 3,
+         "platform": "tpu", "rtt_dominated": True, "dtype": "float32",
+         "gcell_per_sec_per_chip": 300.0},                      # rtt: drop
+    ]
+    p = tmp_path / "r.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    best = bench._best_committed_tpu_record(str(p))
+    assert best == {
+        "gcell_per_sec_per_chip": 103.1, "grid": 1024,
+        "dtype": "float32", "time_blocking": 2,
+    }
+    assert bench._best_committed_tpu_record(str(tmp_path / "nope")) is None
+
+
+def test_best_committed_tpu_record_skips_malformed(tmp_path):
+    """Malformed rows (int grid, missing keys) must be skipped, never
+    raised — the helper runs inside bench.py's last line of defense."""
+    import importlib.util, os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_root2", os.path.join(root, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    p = tmp_path / "r.jsonl"
+    p.write_text("\n".join([
+        json.dumps({"bench": "throughput", "stencil": "7pt", "grid": 1024}),
+        json.dumps({"bench": "throughput", "stencil": "7pt",
+                    "grid": [512] * 3}),  # no gcell value
+        "not json at all",
+        json.dumps({"bench": "throughput", "stencil": "7pt",
+                    "grid": [512] * 3, "dtype": "float32",
+                    "gcell_per_sec_per_chip": 84.5}),
+    ]))
+    best = bench._best_committed_tpu_record(str(p))
+    assert best["gcell_per_sec_per_chip"] == 84.5
