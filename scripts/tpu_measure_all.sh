@@ -102,6 +102,18 @@ for mh in 0 1; do
   done
 done
 
+echo "--- stage 3f: 7pt x-factoring A/B (1024^3 fp32 tb=2 — the headline)" | tee -a "$LOG"
+# HEAT3D_FACTOR_7PT=1 trades the headline chain's two x-shifted plane
+# reads for one unshifted add on the plane sum; if it wins, the headline
+# default flips next session (the committed record runs factor=0)
+for f7 in 0 1; do
+  wait_tpu "7pt-factor A/B $f7" || continue
+  out=$(env HEAT3D_FACTOR_7PT=$f7 timeout 1500 python -m heat3d_tpu.bench \
+    --grid 1024 --steps 50 --time-blocking 2 --mesh 1 1 1 \
+    --bench throughput 2>&1 | tail -1)
+  echo "factor_7pt=$f7: $out" | tee -a "$LOG"
+done
+
 echo "--- stage 4: profile traces" | tee -a "$LOG"
 for tb in 1 2; do
   wait_tpu "profile tb=$tb" || continue
