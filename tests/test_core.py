@@ -311,3 +311,42 @@ def test_accumulate_taps_y_factoring_op_counts(monkeypatch):
         accumulate_taps(flat, term, float)
         assert len(calls) == n_terms, (fy, calls)
         assert sum(c[1] == "ysum" for c in calls) == n_ysum
+
+
+def test_27pt_symbol_isotropy():
+    """The judged 27-point stencil's raison d'etre (BASELINE.json config
+    4: 'higher-order'): its Fourier symbol is direction-ISOTROPIC to
+    leading error order, unlike the 7-point's. For wave vectors of equal
+    magnitude along the axis, face-diagonal, and body-diagonal
+    directions, the 27pt Laplacian symbol's directional spread must be
+    far smaller than the 7pt's, and both must be consistent
+    (symbol -> -|k|^2 as k -> 0)."""
+
+    def symbol(weights, k):
+        # lambda(k) = sum_d w_d * exp(i k . d); real by symmetry
+        s = 0.0
+        for (di, dj, dk), w in np.ndenumerate(weights):
+            s += w * np.cos(np.dot(k, (di - 1, dj - 1, dk - 1)))
+        return s
+
+    def spread(weights, kmag):
+        dirs = [
+            np.array([1.0, 0.0, 0.0]),
+            np.array([1.0, 1.0, 0.0]) / np.sqrt(2),
+            np.array([1.0, 1.0, 1.0]) / np.sqrt(3),
+        ]
+        vals = [symbol(weights, kmag * d) for d in dirs]
+        return (max(vals) - min(vals)) / abs(min(vals))
+
+    w7 = STENCILS["7pt"].weights
+    w27 = STENCILS["27pt"].weights
+    kmag = 0.5  # |k|h = 0.5: resolved but finite-h regime
+    s7, s27 = spread(w7, kmag), spread(w27, kmag)
+    # isotropic leading error: directional spread collapses by >= 20x
+    assert s27 < s7 / 20, (s7, s27)
+    # consistency: both symbols approach -|k|^2 in the continuum limit
+    for w in (w7, w27):
+        k = 1e-3
+        assert abs(symbol(w, np.array([k, 0, 0])) / (-(k**2)) - 1) < 1e-5
+        kd = np.array([1.0, 1.0, 1.0]) / np.sqrt(3) * k
+        assert abs(symbol(w, kd) / (-(k**2)) - 1) < 1e-5
