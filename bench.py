@@ -274,38 +274,47 @@ def _best_committed_tpu_record(paths=None):
             os.path.join(here, "bench_results.jsonl"),
             os.path.join(here, "bench_results_r2.jsonl"),
         ]
+    elif isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
     best = None
     for path in paths:
+        # the WHOLE per-file read is guarded: this helper runs inside the
+        # last-line-of-defense fallback, so a mid-iteration I/O error must
+        # cost one file, never the artifact
         try:
             f = open(path)
         except OSError:
             continue
-        with f:
-            for line in f:
-                # this helper runs inside the last-line-of-defense
-                # fallback: a malformed row must be skipped, never raised
-                try:
-                    r = json.loads(line)
-                    if not (
-                        isinstance(r, dict)
-                        and r.get("bench") == "throughput"
-                        and r.get("stencil") == "7pt"
-                        and r.get("platform", "tpu") == "tpu"
-                        and not r.get("rtt_dominated")
-                        and float(r["grid"][0]) >= 512
-                    ):
-                        continue
-                    g = float(r["gcell_per_sec_per_chip"])
-                    cand = {
-                        "gcell_per_sec_per_chip": round(g, 3),
-                        "grid": r["grid"][0],
-                        "dtype": r["dtype"],
-                        "time_blocking": r.get("time_blocking", 1),
-                    }
-                except Exception:  # noqa: BLE001 - skip malformed rows
+        try:
+            lines = list(f)
+        except OSError:
+            continue
+        finally:
+            f.close()
+        for line in lines:
+            # a malformed row must be skipped, never raised
+            try:
+                r = json.loads(line)
+                if not (
+                    isinstance(r, dict)
+                    and r.get("bench") == "throughput"
+                    and r.get("stencil") == "7pt"
+                    and r.get("platform", "tpu") == "tpu"
+                    and not r.get("rtt_dominated")
+                    and float(r["grid"][0]) >= 512
+                ):
                     continue
-                if best is None or g > best["gcell_per_sec_per_chip"]:
-                    best = cand
+                g = float(r["gcell_per_sec_per_chip"])
+                cand = {
+                    "gcell_per_sec_per_chip": round(g, 3),
+                    "grid": r["grid"][0],
+                    "dtype": r["dtype"],
+                    "time_blocking": r.get("time_blocking", 1),
+                }
+            except Exception:  # noqa: BLE001 - skip malformed rows
+                continue
+            if best is None or g > best["gcell_per_sec_per_chip"]:
+                best = cand
     return best
 
 

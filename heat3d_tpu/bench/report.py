@@ -48,6 +48,22 @@ def _fmt_mesh(mesh) -> str:
     return "×".join(str(m) for m in mesh)
 
 
+def _fmt_route(r: Dict) -> str:
+    """Compact route provenance for a throughput row: transport tier
+    (direct = BC-fused one-sweep kernel, exch = pad-exchange path), local
+    compute route (mehrstellen vs tap chain) and its emitted op count —
+    so a committed table row is self-describing without consulting the
+    env knobs that were live when it was measured. Rows predating the
+    provenance fields (the archived r2 record) render an em dash."""
+    if "direct_path" not in r and "chain_ops" not in r:
+        return "—"
+    parts = ["direct" if r.get("direct_path") else "exch"]
+    route = "mehr" if r.get("mehrstellen_route") else "chain"
+    ops = r.get("chain_ops")
+    parts.append(f"{route}({ops})" if ops is not None else route)
+    return " ".join(parts)
+
+
 def scaling_rows(results: List[Dict]) -> List[Dict]:
     """Compute weak/strong-scaling efficiency for multi-chip throughput rows
     against the matching 1-chip baseline in the same result set.
@@ -123,8 +139,8 @@ def render(results: List[Dict]) -> str:
         lines += [
             "### Throughput (measured)",
             "",
-            "| Grid | Stencil | Mesh | Dtype | Backend | tb | Steps | Gcell/s | Gcell/s/chip | RTT-dominated |",
-            "|---|---|---|---|---|---|---|---|---|---|",
+            "| Grid | Stencil | Mesh | Dtype | Backend | tb | Route | Steps | Gcell/s | Gcell/s/chip | RTT-dominated |",
+            "|---|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in thr:
             dtype = r["dtype"]
@@ -134,7 +150,7 @@ def render(results: List[Dict]) -> str:
             lines.append(
                 f"| {_fmt_grid(r['grid'])} | {r['stencil']} | "
                 f"{_fmt_mesh(r['mesh'])} | {dtype} | {r['backend']} | "
-                f"{r.get('time_blocking', 1)} | "
+                f"{r.get('time_blocking', 1)} | {_fmt_route(r)} | "
                 f"{r['steps']} | {r['gcell_per_sec']:.2f} | "
                 f"{r['gcell_per_sec_per_chip']:.2f} | "
                 f"{'yes' if r.get('rtt_dominated') else 'no'} |"

@@ -55,11 +55,23 @@ def save(path: str, u: jax.Array, step: int, extra: Optional[dict] = None) -> No
             _to_saveable(np.asarray(shard.data)),
         )
     if jax.process_index() == 0:
+        # Record the FULL save layout (every shard start, addressable or
+        # not — derivable on process 0 from the global sharding), so load
+        # can ignore stale shard_*.npy files a prior save with a different
+        # mesh left in the same directory (save never deletes other
+        # processes' files, so the directory alone is not authoritative).
+        starts = sorted(
+            {
+                _index_start(idx, u.shape)
+                for idx in u.sharding.devices_indices_map(u.shape).values()
+            }
+        )
         manifest = {
             "step": int(step),
             "global_shape": list(u.shape),
             "dtype": str(u.dtype),
             "format": 1,
+            "shards": [list(s) for s in starts],
             "extra": extra or {},
         }
         tmp = os.path.join(path, MANIFEST + ".tmp")
@@ -73,11 +85,45 @@ def load_manifest(path: str) -> dict:
         return json.load(f)
 
 
+def _saved_blocks(path: str, ndim: int, allowed=None):
+    """Enumerate the saved shard blocks as (start, shape, filename).
+
+    Shapes come from the .npy headers via mmap — no block data is read
+    here. ``allowed`` (the manifest's recorded shard starts, when
+    present) filters out stale shard files a prior save with a different
+    mesh left in the directory; without it (pre-``shards`` manifests)
+    every shard file is trusted."""
+    blocks = []
+    for fn in sorted(os.listdir(path)):
+        if not (fn.startswith("shard_") and fn.endswith(".npy")):
+            continue
+        try:
+            start = tuple(int(x) for x in fn[len("shard_"):-len(".npy")].split("_"))
+        except ValueError:
+            continue
+        if len(start) != ndim:
+            continue
+        if allowed is not None and start not in allowed:
+            continue
+        arr = np.load(os.path.join(path, fn), mmap_mode="r")
+        blocks.append((start, arr.shape, fn))
+    return blocks
+
+
 def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
-    """Restore (field, step, extra) onto ``sharding``. Works for any mesh
-    shape whose shard boundaries align with the saved files' blocks (the
-    usual resume-on-same-mesh case), and for any mesh when the save was
-    single-shard."""
+    """Restore (field, step, extra) onto ``sharding``.
+
+    The resume mesh does NOT need to match the save mesh: a requested
+    shard is served by its exactly-matching saved file when one exists
+    (the usual resume-on-same-mesh case — zero-copy of the stitch path),
+    and otherwise stitched from every saved block that overlaps it, so a
+    run checkpointed on one decomposition resumes on any other (e.g. a
+    pod run restarted at a different slice size, or a single-chip
+    inspection of a pod checkpoint). Stitching requires the overlapping
+    blocks to be readable from this process — on multi-host filesystems
+    that are not shared, cross-mesh resume needs the shard files
+    consolidated first (same-mesh resume only ever touches local files).
+    """
     manifest = load_manifest(path)
     shape = tuple(manifest["global_shape"])
     dtype_str = manifest["dtype"]
@@ -88,18 +134,12 @@ def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
         arr = np.load(single)
         if arr.shape == shape:
             full = _from_saved(arr, dtype_str)
+    blocks = None  # scanned lazily, only when a cross-mesh stitch is needed
 
     def cb(index):
         if full is not None:
             return full[index]
         start = _index_start(index, shape)
-        fname = os.path.join(path, _shard_filename(start))
-        if not os.path.exists(fname):
-            raise FileNotFoundError(
-                f"checkpoint {path} has no shard starting at {start}; "
-                "resume mesh must match the save mesh (or save single-device)"
-            )
-        arr = np.load(fname)
         want = tuple(
             (0 if sl.stop is None else sl.stop) - (0 if sl.start is None else sl.start)
             for sl, n in zip(index, shape)
@@ -109,11 +149,46 @@ def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
             n if (sl.start is None and sl.stop is None) else w
             for sl, n, w in zip(index, shape, want)
         )
-        if arr.shape != want:
-            raise ValueError(
-                f"shard at {start} has shape {arr.shape}, sharding wants {want}"
+        fname = os.path.join(path, _shard_filename(start))
+        if os.path.exists(fname):
+            # mmap probe: the header check must not pay a full read of a
+            # wrong-shape block (the stitch below re-reads it lazily)
+            arr = np.load(fname, mmap_mode="r")
+            if arr.shape == want:
+                return _from_saved(np.array(arr), dtype_str)
+        # cross-mesh resume: stitch this shard from overlapping saved blocks
+        nonlocal blocks
+        if blocks is None:
+            listed = manifest.get("shards")
+            allowed = {tuple(s) for s in listed} if listed else None
+            blocks = _saved_blocks(path, len(shape), allowed)
+        out = None
+        filled = np.zeros(want, dtype=bool)
+        for bstart, bshape, bfn in blocks:
+            lo = tuple(max(s, bs) for s, bs in zip(start, bstart))
+            hi = tuple(
+                min(s + w, bs + bw)
+                for s, w, bs, bw in zip(start, want, bstart, bshape)
             )
-        return _from_saved(arr, dtype_str)
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue
+            arr = np.load(os.path.join(path, bfn), mmap_mode="r")
+            if out is None:
+                out = np.empty(want, dtype=arr.dtype)
+            dst = tuple(slice(l - s, h - s) for l, h, s in zip(lo, hi, start))
+            src = tuple(slice(l - b, h - b) for l, h, b in zip(lo, hi, bstart))
+            out[dst] = arr[src]
+            filled[dst] = True
+        covered = int(np.count_nonzero(filled))  # mask: overlap-proof
+        if covered != int(np.prod(want)):
+            raise FileNotFoundError(
+                f"checkpoint {path}: saved blocks cover {covered} of "
+                f"{int(np.prod(want))} cells of the shard at {start} "
+                f"(shape {want}) — shard files missing or not visible to "
+                "this process (cross-mesh resume needs all overlapping "
+                "blocks readable; consolidate multi-host shards first)"
+            )
+        return _from_saved(out, dtype_str)
 
     u = jax.make_array_from_callback(shape, sharding, cb)
     return u, int(manifest["step"]), manifest.get("extra", {})

@@ -91,6 +91,32 @@ def test_report_renders_and_updates_markers(tmp_path):
     assert md.read_text().count(report.BEGIN) == 1
 
 
+def test_report_route_column():
+    """The throughput table renders the per-row route provenance
+    (transport tier + compute route + emitted op count), and rows
+    predating the provenance fields (the archived r2 record) render a
+    placeholder instead of a misleading default."""
+    from heat3d_tpu.bench.report import _fmt_route, render
+
+    new_row = {
+        "bench": "throughput", "grid": [512] * 3, "stencil": "27pt",
+        "mesh": [1, 1, 1], "dtype": "float32", "backend": "auto",
+        "steps": 50, "gcell_per_sec": 30.0, "gcell_per_sec_per_chip": 30.0,
+        "rtt_dominated": False, "chain_ops": 15, "direct_path": True,
+        "mehrstellen_route": False,
+    }
+    old_row = {k: v for k, v in new_row.items()
+               if k not in ("chain_ops", "direct_path", "mehrstellen_route")}
+    assert _fmt_route(new_row) == "direct chain(15)"
+    assert _fmt_route({**new_row, "direct_path": False,
+                       "mehrstellen_route": True, "chain_ops": 14}) == \
+        "exch mehr(14)"
+    assert _fmt_route(old_row) == "—"
+    text = render([new_row, old_row])
+    assert "| Route |" in text
+    assert "direct chain(15)" in text
+
+
 def test_root_bench_emits_one_json_line():
     out = subprocess.run(
         [sys.executable, "bench.py"],
