@@ -192,3 +192,90 @@ def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
 
     u = jax.make_array_from_callback(shape, sharding, cb)
     return u, int(manifest["step"]), manifest.get("extra", {})
+
+
+def consolidate(path: str, out_path: Optional[str] = None) -> str:
+    """Merge a sharded checkpoint into a single-block one.
+
+    Assembles the full field from the saved blocks (manifest-listed only,
+    so stale files from older saves in the same directory are ignored),
+    writes it as the one block a ``(0,...,0)`` start names, rewrites the
+    manifest's ``shards`` accordingly, and deletes the now-redundant
+    listed shard files. This is the gather step the multi-host workflow
+    needs before cross-mesh resume on a non-shared filesystem (copy every
+    host's shard files into one directory, then consolidate); the result
+    also loads fastest on any mesh (the replicated ``full`` fast path).
+
+    ``out_path`` writes the consolidated checkpoint elsewhere and leaves
+    the input untouched. Returns the consolidated checkpoint directory.
+    """
+    manifest = load_manifest(path)
+    shape = tuple(manifest["global_shape"])
+    listed = manifest.get("shards")
+    allowed = {tuple(s) for s in listed} if listed else None
+    blocks = _saved_blocks(path, len(shape), allowed)
+    if not blocks:
+        raise FileNotFoundError(f"checkpoint {path}: no shard files found")
+    out = None
+    filled = np.zeros(shape, dtype=bool)
+    for bstart, bshape, bfn in blocks:
+        arr = np.load(os.path.join(path, bfn), mmap_mode="r")
+        if out is None:
+            out = np.empty(shape, dtype=arr.dtype)
+        dst = tuple(slice(b, b + w) for b, w in zip(bstart, bshape))
+        out[dst] = arr
+        filled[dst] = True
+    covered = int(np.count_nonzero(filled))
+    if covered != int(np.prod(shape)):
+        raise FileNotFoundError(
+            f"checkpoint {path}: saved blocks cover {covered} of "
+            f"{int(np.prod(shape))} cells — copy every host's shard files "
+            "into this directory before consolidating"
+        )
+    dest = out_path or path
+    os.makedirs(dest, exist_ok=True)
+    np.save(os.path.join(dest, _shard_filename((0,) * len(shape))), out)
+    manifest["shards"] = [[0] * len(shape)]
+    tmp = os.path.join(dest, MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+    os.replace(tmp, os.path.join(dest, MANIFEST))
+    if dest == path:
+        zero = _shard_filename((0,) * len(shape))
+        for _, _, bfn in blocks:
+            if bfn != zero:
+                os.remove(os.path.join(path, bfn))
+    return dest
+
+
+def _cli(argv=None) -> int:
+    """``python -m heat3d_tpu.utils.checkpoint consolidate DIR [-o OUT]``."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="heat3d_tpu.utils.checkpoint",
+        description="checkpoint maintenance tools",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser(
+        "consolidate", help="merge a sharded checkpoint into one block"
+    )
+    c.add_argument("path", help="checkpoint directory")
+    c.add_argument(
+        "-o", "--out", default=None,
+        help="write the consolidated checkpoint here (default: in place)",
+    )
+    args = p.parse_args(argv)
+    dest = consolidate(args.path, args.out)
+    m = load_manifest(dest)
+    print(
+        f"consolidated {args.path} -> {dest}: step {m['step']}, "
+        f"shape {tuple(m['global_shape'])}, dtype {m['dtype']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_cli())

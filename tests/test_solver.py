@@ -212,6 +212,40 @@ def test_checkpoint_cross_mesh_resume(tmp_path):
         solver.load_checkpoint(str(path))
 
 
+def test_checkpoint_consolidate(tmp_path):
+    """consolidate merges a sharded save into the single-block layout (the
+    multi-host gather-then-resume workflow), removing the listed shard
+    files it replaced; the result round-trips through load."""
+    from heat3d_tpu.utils import checkpoint as ckpt
+
+    rng = np.random.default_rng(11)
+    full = rng.standard_normal((16, 16, 16)).astype(np.float32)
+    path = tmp_path / "ckc"
+    path.mkdir()
+    starts = [(sx, sy, sz) for sx in (0, 8) for sy in (0, 8) for sz in (0, 8)]
+    for sx, sy, sz in starts:
+        np.save(path / ckpt._shard_filename((sx, sy, sz)),
+                full[sx:sx + 8, sy:sy + 8, sz:sz + 8])
+    (path / ckpt.MANIFEST).write_text(json.dumps({
+        "step": 3, "global_shape": [16, 16, 16], "dtype": "float32",
+        "format": 1, "shards": [list(s) for s in starts], "extra": {},
+    }))
+    # -o leaves the input untouched
+    dest = ckpt.consolidate(str(path), str(tmp_path / "out"))
+    assert ckpt.load_manifest(dest)["shards"] == [[0, 0, 0]]
+    np.testing.assert_array_equal(
+        np.load(os.path.join(dest, ckpt._shard_filename((0, 0, 0)))), full)
+    assert (path / ckpt._shard_filename((8, 8, 8))).exists()
+    # in place: shard files replaced by the one block, load still works
+    ckpt.consolidate(str(path))
+    assert sorted(f for f in os.listdir(path) if f.endswith(".npy")) == \
+        [ckpt._shard_filename((0, 0, 0))]
+    solver, _ = make_solver()
+    u, step = solver.load_checkpoint(str(path))
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(solver.gather(u)), full)
+
+
 def test_cli_exact_step_count_and_periodic_checkpoint(tmp_path, capsys):
     # --steps N must run exactly N updates even with --residual-every, and
     # --checkpoint-every must fire on its grid (regression: review findings).
