@@ -116,7 +116,13 @@ def _child_main() -> int:
 
     Runs exactly one configuration (no ladder — the parent owns retry
     policy) and prints one JSON line. A wedged backend hangs only this
-    killable child."""
+    killable child. SIGTERM is converted to SystemExit so Python cleanup
+    (PJRT client destructors) releases any chip claim before death — a
+    SIGKILLed child holding the axon pool's claim leaves it stale and
+    blocks every later rung (the claim-cascade failure mode)."""
+    from heat3d_tpu.utils.backendprobe import install_sigterm_exit
+
+    install_sigterm_exit()
     import jax
 
     platform = jax.devices()[0].platform
@@ -202,23 +208,46 @@ def _measure_in_child(grid_edge=None, cpu=False, last_rung=False):
     budget = _remaining() - reserve
     if not cpu and not last_rung:
         budget *= 0.5
-    timeout = max(60.0, min(timeout, budget))
-    proc = subprocess.run(
+    # Graceful timeout: SIGTERM + grace, SIGKILL only as a last resort.
+    # subprocess.run(timeout=) SIGKILLs, and a SIGKILLed child holding the
+    # axon pool's single-chip claim leaves it stale, wedging every later
+    # rung (and the next session) until the server expires it. The grace
+    # period is paid OUT of the rung's budget so a child that ignores
+    # SIGTERM still can't push the JSON line past the shared deadline.
+    grace = 20.0
+    timeout = max(60.0, min(timeout, budget - grace))
+    proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         env=env,
-        capture_output=True,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
         text=True,
-        timeout=timeout,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
-    sys.stderr.write(proc.stderr)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        how = "terminated gracefully (claim released)"
+        try:
+            stdout, stderr = proc.communicate(timeout=grace)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+            how = "SIGKILLed after ignoring SIGTERM — any chip claim is stale"
+        if stderr:
+            sys.stderr.write(stderr)
+        raise RuntimeError(
+            f"measurement child timed out after {timeout:.0f}s ({how})"
+        ) from None
+    sys.stderr.write(stderr)
     if proc.returncode != 0:
-        err_lines = proc.stderr.strip().splitlines()
+        err_lines = stderr.strip().splitlines()
         raise RuntimeError(
             f"measurement child rc={proc.returncode}: "
             f"{err_lines[-1] if err_lines else '?'}"
         )
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+    return json.loads(stdout.strip().splitlines()[-1])
 
 
 def main() -> int:

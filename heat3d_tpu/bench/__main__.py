@@ -17,6 +17,11 @@ from heat3d_tpu.bench.harness import bench_halo, bench_throughput, run_suite
 
 
 def main(argv=None) -> int:
+    # Suite rows are stopped with `timeout` (SIGTERM) when they overrun;
+    # the dying row must release the axon pool's chip claim on the way out.
+    from heat3d_tpu.utils.backendprobe import install_sigterm_exit
+
+    install_sigterm_exit()
     base = build_parser()
     p = argparse.ArgumentParser(
         prog="heat3d-bench", parents=[base], add_help=False, conflict_handler="resolve"

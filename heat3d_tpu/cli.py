@@ -162,6 +162,11 @@ def config_from_args(args) -> SolverConfig:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # A measurement script stopping this run with `timeout` (SIGTERM) must
+    # release the axon pool's chip claim on the way out, not die holding it.
+    from heat3d_tpu.utils.backendprobe import install_sigterm_exit
+
+    install_sigterm_exit()
     try:
         return _main(argv)
     except (ValueError, NotImplementedError) as e:

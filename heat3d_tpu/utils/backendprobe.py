@@ -23,19 +23,51 @@ def probe_timeout(default: float = 60.0) -> float:
     return float(os.environ.get("HEAT3D_PROBE_TIMEOUT", default))
 
 
+# The child converts SIGTERM into a normal SystemExit so Python cleanup
+# (atexit, PJRT client destructors) runs before the process dies. Without
+# this, a probe that is granted the pool's chip claim just before its
+# timeout dies by SIGKILL mid-init and leaves a STALE SERVER-SIDE CLAIM —
+# the probe then re-wedges the very pool it is checking, every interval,
+# for as long as probing continues (observed: probes under CPU-load-slowed
+# jax init turning one wedge into a persistent one).
+_SIGTERM_TO_EXIT = (
+    "import signal, sys; "
+    "signal.signal(signal.SIGTERM, lambda *a: sys.exit(3)); "
+)
+
+
 def _probe(code: str, timeout: Optional[float]) -> Optional[str]:
+    """Run ``code`` in a killable child; graceful termination on timeout.
+
+    SIGTERM first (so the child's cleanup can release any chip claim it
+    holds), SIGKILL only if it ignores the grace period. Best-effort: a
+    child blocked inside a non-returning C call (a hung tunnel RPC) can't
+    run its Python handler and still dies by the follow-up SIGKILL — but
+    such a child was stuck BEFORE the claim grant; the dangerous
+    granted-and-initializing window is Python-mediated and does yield."""
+    budget = probe_timeout() if timeout is None else timeout
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SIGTERM_TO_EXIT + code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
-            timeout=probe_timeout() if timeout is None else timeout,
         )
-    except (subprocess.TimeoutExpired, OSError):
+    except OSError:
+        return None
+    try:
+        out, _ = proc.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=15.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
         return None
     if proc.returncode != 0:
         return None
-    lines = proc.stdout.strip().splitlines()
+    lines = out.strip().splitlines()
     return lines[-1] if lines else None
 
 
@@ -82,6 +114,25 @@ def wait_for_backend(
         if time.monotonic() - start >= deadline_s:
             return None
         time.sleep(interval_s)
+
+
+def install_sigterm_exit(code: int = 3) -> None:
+    """Convert SIGTERM into ``SystemExit`` in the calling process.
+
+    Python's default SIGTERM disposition kills the process without running
+    atexit or destructors — so a chip-claiming process stopped by
+    coreutils ``timeout`` (which TERMs) dies holding the axon pool's
+    single-chip claim, wedging every later claimant until the server
+    expires it. Every entry point a measurement script may time-bound
+    (solver CLI, bench CLI, bench.py children) installs this so
+    termination releases the claim on the way out. Main-thread only
+    (signal module requirement); no-op elsewhere."""
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        return
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(code))
 
 
 def _main() -> int:

@@ -233,6 +233,10 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
             "into this directory before consolidating"
         )
     dest = out_path or path
+    # realpath, not string, equality: `-o /ck/` (trailing slash, relative
+    # spelling, symlink) naming the input must behave as in-place — delete
+    # the replaced shard files — not as a broken hybrid of both modes
+    in_place = os.path.realpath(dest) == os.path.realpath(path)
     os.makedirs(dest, exist_ok=True)
     np.save(os.path.join(dest, _shard_filename((0,) * len(shape))), out)
     manifest["shards"] = [[0] * len(shape)]
@@ -240,7 +244,7 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=2)
     os.replace(tmp, os.path.join(dest, MANIFEST))
-    if dest == path:
+    if in_place:
         zero = _shard_filename((0,) * len(shape))
         for _, _, bfn in blocks:
             if bfn != zero:

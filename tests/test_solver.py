@@ -236,8 +236,10 @@ def test_checkpoint_consolidate(tmp_path):
     np.testing.assert_array_equal(
         np.load(os.path.join(dest, ckpt._shard_filename((0, 0, 0)))), full)
     assert (path / ckpt._shard_filename((8, 8, 8))).exists()
-    # in place: shard files replaced by the one block, load still works
-    ckpt.consolidate(str(path))
+    # in place: shard files replaced by the one block, load still works.
+    # -o naming the input by another spelling (trailing slash) must be
+    # recognized as in-place, not a broken hybrid of both modes.
+    ckpt.consolidate(str(path), str(path) + "/")
     assert sorted(f for f in os.listdir(path) if f.endswith(".npy")) == \
         [ckpt._shard_filename((0, 0, 0))]
     solver, _ = make_solver()
