@@ -117,6 +117,55 @@ def test_report_route_column():
     assert "direct chain(15)" in text
 
 
+def test_ab_decide_pairs_and_thresholds(tmp_path):
+    """scripts/ab_decide.py pairs rows differing in exactly one knob,
+    scopes to the LAST session by default, and thresholds small wins."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ab_decide", os.path.join(root, "scripts", "ab_decide.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    text = (
+        "=== tpu_measure_all old ===\n"
+        'factor_y=0 tb=1: {"gcell_per_sec_per_chip": 999.0}\n'  # stale
+        "=== tpu_measure_all new ===\n"
+        'factor_y=1 tb=1: {"gcell_per_sec_per_chip": 30.0}\n'
+        'factor_y=0 tb=1: {"gcell_per_sec_per_chip": 25.0}\n'
+        'factor_y=0 tb=2: {"gcell_per_sec_per_chip": 35.0}\n'  # 2-knob diff
+        'direct: {"gcell_per_sec_per_chip": 80.0}\n'
+        'exchange: {"gcell_per_sec_per_chip": 78.0}\n'
+        "not an ab line\n"
+    )
+    entries = list(mod.parse_lines(text))
+    # stale-session line excluded
+    assert all(r["gcell_per_sec_per_chip"] != 999.0 for _, r in entries)
+    decisions = mod.decide(entries, min_win_pct=5.0)
+    by_knob = {(d["knob"], tuple(sorted(d["context"].items()))): d
+               for d in decisions}
+    fy = by_knob[("factor_y", (("tb", "1"),))]
+    assert fy["winner"] == "1" and fy["decisive"]
+    mode = by_knob[("mode", ())]
+    assert mode["winner"] == "direct" and not mode["decisive"]
+    # margin is symmetric: winner-vs-loser, not second-vs-first. 21 vs 20
+    # is a 5.0% win whichever side carries the lower knob value.
+    for hi_first in (True, False):
+        a, b = (21.0, 20.0) if hi_first else (20.0, 21.0)
+        d = mod.decide(
+            [({"k": "0"}, {"gcell_per_sec_per_chip": a}),
+             ({"k": "1"}, {"gcell_per_sec_per_chip": b})],
+            min_win_pct=5.0,
+        )[0]
+        assert d["speedup_pct"] == 5.0 and d["decisive"]
+    # rows differing in BOTH factor_y and tb never pair directly
+    assert ("tb", (("factor_y", "0"),)) in by_knob  # same-knob tb pair OK
+    assert ("factor_y", (("tb", "2"),)) not in by_knob
+
+
 def test_root_bench_emits_one_json_line():
     out = subprocess.run(
         [sys.executable, "bench.py"],
