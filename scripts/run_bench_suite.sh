@@ -125,7 +125,7 @@ for stencil in ${STENCILS:-7pt 27pt}; do
             # and the halo line — fill in just the missing halo row
             note "suite: backfilling halo row grid=$grid dtype=$dtype"
             wait_tpu "halo backfill grid=$grid" || continue
-            timeout "${ROW_TIMEOUT:-900}" \
+            timeout -k 30 "${ROW_TIMEOUT:-900}" \
               python -m heat3d_tpu.bench --grid "$grid" \
               --steps "${STEPS:-50}" --dtype "$dtype" --mesh 1 1 1 \
               --bench halo >> "$OUT" 2>>"$SUITE_LOG" \
@@ -139,7 +139,7 @@ for stencil in ${STENCILS:-7pt 27pt}; do
         # aborts; ROW_TIMEOUT bounds a row that hangs on a wedged tunnel
         # (one stuck 1024^3 transfer must cost one row, not the stage)
         wait_tpu "$stencil grid=$grid dtype=$dtype tb=$tb" || continue
-        timeout "${ROW_TIMEOUT:-900}" \
+        timeout -k 30 "${ROW_TIMEOUT:-900}" \
           python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
           --stencil "$stencil" --dtype "$dtype" --time-blocking "$tb" \
           --mesh 1 1 1 --bench "$bench" \
@@ -162,7 +162,7 @@ if [[ -z "${SKIP_BF16_COMPUTE:-}" ]]; then
       continue
     fi
     wait_tpu "bf16-compute grid=$grid" || continue
-    timeout "${ROW_TIMEOUT:-900}" \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
       python -m heat3d_tpu.bench --grid "$grid" --steps "${STEPS:-50}" \
       --dtype bf16 --compute-dtype bf16 --time-blocking 2 --mesh 1 1 1 \
       --bench throughput >> "$OUT" 2>>"$SUITE_LOG" \
@@ -174,7 +174,7 @@ if [[ -z "${SKIP_OVERLAP:-}" ]]; then
   if has_row 7pt "${OVERLAP_GRID:-512}" fp32 1 fp32 1; then
     note "suite: already recorded overlap run"
   elif wait_tpu "overlap run"; then
-    timeout "${ROW_TIMEOUT:-900}" \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
       python -m heat3d_tpu.bench --grid "${OVERLAP_GRID:-512}" \
       --steps "${STEPS:-50}" --overlap --mesh 1 1 1 --bench throughput \
       >> "$OUT" 2>>"$SUITE_LOG" \

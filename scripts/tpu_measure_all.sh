@@ -37,12 +37,12 @@ if ! wait_tpu "initial probe"; then
 fi
 
 echo "--- stage 1: smoke tier" | tee -a "$LOG"
-timeout 900 python -m pytest tests/ -m tpu_smoke -q 2>&1 | tail -3 | tee -a "$LOG"
+timeout -k 30 900 python -m pytest tests/ -m tpu_smoke -q 2>&1 | tail -3 | tee -a "$LOG"
 
 echo "--- stage 2: bench suite" | tee -a "$LOG"
 # The suite probe-gates each row internally; its stderr log (suite: ...
 # skip/fail lines + row tracebacks) is bench_results.err.log.
-timeout "${SUITE_TIMEOUT:-7200}" bash scripts/run_bench_suite.sh \
+timeout -k 30 "${SUITE_TIMEOUT:-7200}" bash scripts/run_bench_suite.sh \
   bench_results.jsonl 2>&1 | tail -3 | tee -a "$LOG"
 
 echo "--- stage 3: headline bench" | tee -a "$LOG"
@@ -50,14 +50,14 @@ echo "--- stage 3: headline bench" | tee -a "$LOG"
 # includes up to ~900 s of claim-outlasting probes) so the JSON line always
 # lands before SIGKILL
 wait_tpu "headline bench" \
-  && timeout 1800 python bench.py 2>&1 | tee -a "$LOG"
+  && timeout -k 30 1800 python bench.py 2>&1 | tee -a "$LOG"
 
 echo "--- stage 3b: direct-vs-exchange A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
 for mode in direct exchange; do
   env_prefix=()
   [[ $mode == exchange ]] && env_prefix=(env HEAT3D_NO_DIRECT=1)
   wait_tpu "A/B $mode" || continue
-  out=$("${env_prefix[@]}" timeout 1200 python -m heat3d_tpu.bench \
+  out=$("${env_prefix[@]}" timeout -k 30 1200 python -m heat3d_tpu.bench \
     --grid 512 --steps 50 --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
   echo "$mode: $out" | tee -a "$LOG"
 done
@@ -68,7 +68,7 @@ echo "--- stage 3c: 27pt y-factoring A/B (512^3 fp32)" | tee -a "$LOG"
 for fy in 1 0; do
   for tb in 1 2; do
     wait_tpu "27pt A/B fy=$fy tb=$tb" || continue
-    out=$(env HEAT3D_FACTOR_Y=$fy timeout 1200 python -m heat3d_tpu.bench \
+    out=$(env HEAT3D_FACTOR_Y=$fy timeout -k 30 1200 python -m heat3d_tpu.bench \
       --grid 512 --steps 50 --stencil 27pt --time-blocking $tb \
       --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
     echo "factor_y=$fy tb=$tb: $out" | tee -a "$LOG"
@@ -83,7 +83,7 @@ echo "--- stage 3d: bf16-compute A/B (1024^3 tb=2)" | tee -a "$LOG"
 for dt in "bf16 fp32" "bf16 bf16" "fp32 bf16"; do
   read -r st cd <<<"$dt"
   wait_tpu "compute A/B $st/$cd" || continue
-  out=$(timeout 1200 python -m heat3d_tpu.bench --grid 1024 --steps 50 \
+  out=$(timeout -k 30 1200 python -m heat3d_tpu.bench --grid 1024 --steps 50 \
     --dtype $st --compute-dtype $cd --time-blocking 2 --mesh 1 1 1 \
     --bench throughput 2>&1 | tail -1)
   echo "storage=$st compute=$cd: $out" | tee -a "$LOG"
@@ -95,7 +95,7 @@ echo "--- stage 3e: 27pt mehrstellen A/B (512^3 fp32, tb=1 and tb=2)" | tee -a "
 for mh in 0 1; do
   for tb in 1 2; do
     wait_tpu "mehrstellen A/B mh=$mh tb=$tb" || continue
-    out=$(env HEAT3D_MEHRSTELLEN=$mh timeout 1200 python -m heat3d_tpu.bench \
+    out=$(env HEAT3D_MEHRSTELLEN=$mh timeout -k 30 1200 python -m heat3d_tpu.bench \
       --grid 512 --steps 50 --stencil 27pt --time-blocking $tb \
       --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
     echo "mehrstellen=$mh tb=$tb: $out" | tee -a "$LOG"
@@ -108,7 +108,7 @@ echo "--- stage 3f: 7pt x-factoring A/B (1024^3 fp32 tb=2 — the headline)" | t
 # default flips next session (the committed record runs factor=0)
 for f7 in 0 1; do
   wait_tpu "7pt-factor A/B $f7" || continue
-  out=$(env HEAT3D_FACTOR_7PT=$f7 timeout 1500 python -m heat3d_tpu.bench \
+  out=$(env HEAT3D_FACTOR_7PT=$f7 timeout -k 30 1500 python -m heat3d_tpu.bench \
     --grid 1024 --steps 50 --time-blocking 2 --mesh 1 1 1 \
     --bench throughput 2>&1 | tail -1)
   echo "factor_7pt=$f7: $out" | tee -a "$LOG"
@@ -117,18 +117,21 @@ done
 echo "--- stage 4: profile traces" | tee -a "$LOG"
 for tb in 1 2; do
   wait_tpu "profile tb=$tb" || continue
-  GRID=512 STEPS=20 TB=$tb timeout 1200 \
+  GRID=512 STEPS=20 TB=$tb timeout -k 30 1200 \
     bash scripts/profile_bench.sh "/tmp/heat3d_profile_tb$tb" 2>&1 \
     | tee -a "$LOG"
 done
 # 27pt VPU-bound claim: capture the op mix at the ceiling (VERDICT r2 #4)
 wait_tpu "profile 27pt" && \
-GRID=512 STEPS=20 TB=1 STENCIL=27pt timeout 1200 \
+GRID=512 STEPS=20 TB=1 STENCIL=27pt timeout -k 30 1200 \
   bash scripts/profile_bench.sh "/tmp/heat3d_profile_27pt" 2>&1 \
   | tee -a "$LOG"
 
 # halo p50 rows (device-side k-exchange loop) come from stage 2's suite:
 # one row per (grid, dtype) exchange shape, labeled local-only on the
 # single-chip mesh — the ICI numbers need a pod slice.
+
+echo "--- stage 5: A/B decisions (scripts/ab_decide.py)" | tee -a "$LOG"
+python scripts/ab_decide.py "$LOG" 2>&1 | tee -a "$LOG" || true
 
 echo "=== done $(date -u +%FT%TZ) ===" | tee -a "$LOG"

@@ -13,6 +13,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import pytest
 
+# The measurement scripts time-bound the on-chip smoke tier with coreutils
+# `timeout` (SIGTERM); a test session killed mid-claim must release the
+# axon pool's chip claim on the way out. backendprobe.install_sigterm_exit
+# is the WRONG layer here: its SystemExit would be raised inside whatever
+# test frame is executing, where pytest catches it as that one test's
+# failure and keeps running — claim still held. pytest.exit() ends the
+# whole session (teardown + atexit -> PJRT cleanup). A handler can still
+# only fire between Python bytecodes, so the scripts pair their `timeout`
+# with `-k <grace>` as the SIGKILL backstop for C-stuck sessions.
+
+
+def _sigterm_ends_session(signum, frame):
+    pytest.exit("SIGTERM — releasing backend and ending session", returncode=3)
+
+
+if __import__("threading").current_thread() is __import__("threading").main_thread():
+    __import__("signal").signal(
+        __import__("signal").SIGTERM, _sigterm_ends_session
+    )
+
 
 @pytest.fixture(scope="session")
 def rng():
