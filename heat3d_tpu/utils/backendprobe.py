@@ -107,13 +107,23 @@ def wait_for_backend(
     import time
 
     start = time.monotonic()
+    interval = interval_s
     while True:
         p = probe_platform()
         if p is not None and (want is None or p == want):
             return p
-        if time.monotonic() - start >= deadline_s:
+        remaining = deadline_s - (time.monotonic() - start)
+        if remaining <= 0:
             return None
-        time.sleep(interval_s)
+        # Back off (1.5x, capped at 5 min): every probe is a claim
+        # attempt, and a probe unlucky enough to be granted the chip just
+        # before its timeout can re-wedge the pool (see _probe). During a
+        # long outage, fewer attempts = fewer chances to hit that window;
+        # healing detection latency grows to at most the cap. The sleep is
+        # clamped to the remaining deadline so the wait still returns on
+        # time (one last probe fires right at the deadline edge).
+        time.sleep(min(interval, remaining))
+        interval = min(interval * 1.5, 300.0)
 
 
 def install_sigterm_exit(code: int = 3) -> None:
