@@ -146,6 +146,8 @@ def _chain_ops(cfg: SolverConfig, mehrstellen: bool = None) -> int:
     result so one env evaluation feeds every provenance field."""
     from heat3d_tpu.core.stencils import MEHRSTELLEN_OPS, chain_ops_for
 
+    if cfg.backend == "conv":
+        return None  # one conv op, not a tap chain — op count n/a
     if mehrstellen is None:
         mehrstellen = _mehrstellen_route(cfg)
     if mehrstellen:

@@ -55,6 +55,8 @@ def _fmt_route(r: Dict) -> str:
     so a committed table row is self-describing without consulting the
     env knobs that were live when it was measured. Rows predating the
     provenance fields (the archived r2 record) render an em dash."""
+    if r.get("backend") == "conv":
+        return "conv"  # one XLA conv op — neither transport tier applies
     if "direct_path" not in r and "chain_ops" not in r:
         return "—"
     parts = ["direct" if r.get("direct_path") else "exch"]

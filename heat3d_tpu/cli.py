@@ -66,7 +66,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stencil compute dtype (bf16 halves VPU op width; "
                    "A/B knob for whether bf16 throughput is VPU- or "
                    "assembly-bound); residual still accumulates fp32")
-    p.add_argument("--backend", choices=["auto", "jnp", "pallas"], default="auto")
+    p.add_argument("--backend", choices=["auto", "jnp", "pallas", "conv"], default="auto")
     p.add_argument(
         "--dump-slice", nargs=3, metavar=("AXIS", "INDEX", "PATH"),
         default=None,

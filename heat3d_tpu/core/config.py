@@ -196,7 +196,7 @@ class SolverConfig:
     mesh: MeshConfig = MeshConfig()
     precision: Precision = Precision()
     run: RunConfig = RunConfig()
-    backend: str = "auto"  # 'jnp' | 'pallas' | 'auto' (pallas on TPU else jnp)
+    backend: str = "auto"  # 'jnp' | 'pallas' | 'conv' | 'auto' (pallas on TPU else jnp)
     # Split each step into interior + boundary-shell updates so XLA's async
     # collectives overlap the halo ppermutes with the interior sweep — the
     # TPU analogue of the reference class's two-stream interior/boundary

@@ -45,32 +45,39 @@ def _select_backend(cfg: SolverConfig):
 
     'jnp'    — portable shifted-slice path (ops.stencil_jnp).
     'pallas' — the Pallas TPU kernel (ops.stencil_pallas).
+    'conv'   — one XLA conv_general_dilated (MXU on TPU) — the measured
+               A/B reference for what the chains/kernels buy.
     'auto'   — pallas on TPU when the local block meets the kernel's layout
                constraints, else jnp.
     """
-    from heat3d_tpu.ops.stencil_jnp import apply_taps_padded
+    from heat3d_tpu.ops.stencil_jnp import apply_taps_conv_padded, apply_taps_padded
 
     if cfg.backend == "jnp":
         return apply_taps_padded
-    if cfg.backend in ("pallas", "auto"):
-        try:
-            from heat3d_tpu.ops.stencil_pallas import (
-                make_pallas_compute,
-                pallas_supported,
-            )
+    if cfg.backend == "conv":
+        return apply_taps_conv_padded
+    if cfg.backend not in ("pallas", "auto"):
+        raise ValueError(
+            f"unknown backend {cfg.backend!r} (want auto|jnp|pallas|conv)"
+        )
+    try:
+        from heat3d_tpu.ops.stencil_pallas import (
+            make_pallas_compute,
+            pallas_supported,
+        )
 
-            ok, why = pallas_supported(cfg)
-            if ok:
-                return make_pallas_compute(cfg)
-            if cfg.backend == "pallas":
-                raise ValueError(f"pallas backend unsupported here: {why}")
-            log.info("auto backend: falling back to jnp (%s)", why)
-        except ImportError as e:
-            if cfg.backend == "pallas":
-                raise ValueError(
-                    "pallas backend requested but the Pallas kernel module "
-                    f"could not be imported: {e}"
-                ) from e
+        ok, why = pallas_supported(cfg)
+        if ok:
+            return make_pallas_compute(cfg)
+        if cfg.backend == "pallas":
+            raise ValueError(f"pallas backend unsupported here: {why}")
+        log.info("auto backend: falling back to jnp (%s)", why)
+    except ImportError as e:
+        if cfg.backend == "pallas":
+            raise ValueError(
+                "pallas backend requested but the Pallas kernel module "
+                f"could not be imported: {e}"
+            ) from e
     return apply_taps_padded
 
 

@@ -95,6 +95,44 @@ def apply_taps_padded(
     return acc.astype(out_dtype)
 
 
+def apply_taps_conv_padded(
+    up: jax.Array,
+    taps: np.ndarray,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    mehrstellen: bool = None,
+) -> jax.Array:
+    """The XLA-native route: one ``lax.conv_general_dilated`` with the
+    3x3x3 tap kernel (VALID padding over the ghost-padded block).
+
+    This is the obvious "let the compiler do it" implementation a JAX
+    port would reach for first — on TPU, XLA lowers convolutions onto the
+    MXU. It exists as a measured A/B reference point (``--backend conv``)
+    quantifying what the framework's shifted-slice chains and hand-built
+    Pallas kernels buy over it: with a single channel the MXU runs at
+    1/128th utilization, so the chain/kernel routes are expected to win —
+    this row turns that expectation into a committed number.
+
+    Semantics note: XLA's conv is cross-correlation (no kernel flip),
+    which matches the tap convention ``out[c] = sum_d T[d] u[c+d-1]``
+    exactly (both judged stencils are also reflection-symmetric, making
+    the flip convention moot). ``mehrstellen`` is accepted for LocalCompute
+    signature compatibility and ignored — the conv IS its own route.
+    """
+    out_dtype = out_dtype or up.dtype
+    x = up.astype(compute_dtype)[None, None]  # NCDHW
+    k = jnp.asarray(np.asarray(taps), dtype=compute_dtype)[None, None]  # OIDHW
+    y = jax.lax.conv_general_dilated(
+        x,
+        k,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        preferred_element_type=jnp.dtype(compute_dtype),
+    )
+    return y[0, 0].astype(out_dtype)
+
+
 def _apply_mehrstellen_padded(upc: jax.Array, coeffs, compute_dtype):
     """Separable route for taps that factor as ``a*delta + b*S + d*F``
     (core.stencils.decompose_mehrstellen): three 1D [1,3,1] convolutions

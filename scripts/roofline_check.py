@@ -67,7 +67,9 @@ def vpu_ops_per_cell_update(row) -> int:
     for the count, only which offsets are nonzero, so nominal
     alpha/dt/spacing are fine for the fallback."""
     if "chain_ops" in row:
-        return row["chain_ops"]
+        return row["chain_ops"]  # may be None: conv rows run no tap chain
+    if row.get("backend") == "conv":
+        return None
     from heat3d_tpu.core.stencils import chain_ops_for
 
     return chain_ops_for(row.get("stencil", "7pt"))
@@ -121,7 +123,9 @@ def main() -> int:
         bw_ceiling = args.hbm_gbps / per_update  # Gcell/s/chip
         ops = vpu_ops_per_cell_update(r)
         ceiling, bind = bw_ceiling, "hbm"
-        if args.vpu_gops is not None:
+        # ops is None for conv rows (one XLA conv op, no tap chain): the
+        # VPU model doesn't apply — report against the HBM ceiling only
+        if args.vpu_gops is not None and ops is not None:
             vpu_ceiling = args.vpu_gops / ops
             if vpu_ceiling < bw_ceiling:
                 ceiling, bind = vpu_ceiling, "vpu"
@@ -135,7 +139,8 @@ def main() -> int:
             flag = " (c=bf16)" + flag
         print(f"{grid:>6} {r['dtype']:>8} {r.get('stencil', '7pt'):>4} "
               f"{r.get('time_blocking', 1):>2} {path:>16} "
-              f"{per_update:>10.1f} {ops:>4} {ceiling:>9.1f} {bind:>4} "
+              f"{per_update:>10.1f} {'n/a' if ops is None else ops:>4} "
+              f"{ceiling:>9.1f} {bind:>4} "
               f"{meas:>9.2f} {meas / ceiling:>7.1%}{flag}")
 
     if args.fit:
@@ -163,8 +168,11 @@ def _fit_op_cost(rows) -> None:
             r.get("compute_dtype", "float32"), r.get("backend", "auto"),
             r.get("time_blocking", 1), path,
         )
+        ops = vpu_ops_per_cell_update(r)
+        if ops is None:
+            continue  # conv rows: no tap chain, nothing to fit against
         ns_per_cell = 1.0 / r["gcell_per_sec_per_chip"]  # ns/cell/update
-        groups[key].append((vpu_ops_per_cell_update(r), ns_per_cell))
+        groups[key].append((ops, ns_per_cell))
     printed = False
     for key, pts in sorted(groups.items()):
         by_ops = {}

@@ -52,13 +52,18 @@ echo "--- stage 3: headline bench" | tee -a "$LOG"
 wait_tpu "headline bench" \
   && timeout -k 30 1800 python bench.py 2>&1 | tee -a "$LOG"
 
-echo "--- stage 3b: direct-vs-exchange A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
-for mode in direct exchange; do
+echo "--- stage 3b: direct/exchange/conv A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
+# conv = one XLA conv_general_dilated (MXU) — the obvious XLA-native
+# implementation, measured so the kernels' advantage is a committed number
+for mode in direct exchange conv; do
   env_prefix=()
+  extra=()
   [[ $mode == exchange ]] && env_prefix=(env HEAT3D_NO_DIRECT=1)
+  [[ $mode == conv ]] && extra=(--backend conv)
   wait_tpu "A/B $mode" || continue
   out=$("${env_prefix[@]}" timeout -k 30 1200 python -m heat3d_tpu.bench \
-    --grid 512 --steps 50 --mesh 1 1 1 --bench throughput 2>&1 | tail -1)
+    --grid 512 --steps 50 --mesh 1 1 1 "${extra[@]}" --bench throughput \
+    2>&1 | tail -1)
   echo "$mode: $out" | tee -a "$LOG"
 done
 

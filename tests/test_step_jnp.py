@@ -146,3 +146,42 @@ def test_mehrstellen_route_matches_chain(monkeypatch, bc, bc_value):
     monkeypatch.setenv("HEAT3D_MEHRSTELLEN", "1")
     g7 = step_single_device(u, t7, bc, bc_value)
     np.testing.assert_array_equal(np.asarray(g7), np.asarray(w7))
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_conv_route_matches_tap_chain(kind):
+    """--backend conv (one XLA conv_general_dilated — the MXU route and
+    the measured A/B reference for the chains/kernels) must agree with
+    the canonical tap chain to FMA-reordering rounding."""
+    from heat3d_tpu.ops.stencil_jnp import (
+        apply_taps_conv_padded,
+        apply_taps_padded,
+    )
+
+    taps = stencil_taps(
+        STENCILS[kind], alpha=0.8, dt=0.05, spacing=(1.0, 1.0, 1.0)
+    )
+    rng = np.random.default_rng(5)
+    up = jnp.asarray(rng.standard_normal((10, 9, 12)).astype(np.float32))
+    got = apply_taps_conv_padded(up, taps)
+    want = apply_taps_padded(up, taps, mehrstellen=False)
+    assert got.shape == want.shape == (8, 7, 10)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-6, atol=2e-6
+    )
+
+
+def test_conv_backend_through_solver_cli(capsys):
+    """The conv backend runs the full CLI path and passes the golden
+    oracle (it slots in as a LocalCompute on the exchange path)."""
+    import json as _json
+
+    from heat3d_tpu.cli import main
+
+    rc = main([
+        "--grid", "16", "--steps", "5", "--backend", "conv",
+        "--mesh", "1", "1", "1", "--golden-check",
+    ])
+    assert rc == 0
+    summary = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["golden_pass"] is True
