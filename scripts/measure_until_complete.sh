@@ -19,8 +19,12 @@ for i in $(seq 1 "$ATTEMPTS"); do
   echo "=== measurement attempt $i/$ATTEMPTS $(date -u +%FT%TZ) ==="
   APPEND=1 TPU_WAIT="${TPU_WAIT:-3300}" ROW_TIMEOUT="${ROW_TIMEOUT:-1500}" \
     bash scripts/tpu_measure_all.sh
-  rows=$(grep -c '"bench": "throughput"' bench_results.jsonl || true)
-  halos=$(grep -c '"bench": "halo"' bench_results.jsonl || true)
+  # grep -c prints nothing (not 0) when the file is missing — default so
+  # the -ge tests below stay integer comparisons on a fresh record
+  rows=$(grep -c '"bench": "throughput"' bench_results.jsonl 2>/dev/null || true)
+  halos=$(grep -c '"bench": "halo"' bench_results.jsonl 2>/dev/null || true)
+  rows=${rows:-0}
+  halos=${halos:-0}
   echo "=== attempt $i done: $rows throughput + $halos halo rows ==="
   if [ "$rows" -ge "${MIN_ROWS:-15}" ] && [ "$halos" -ge "${MIN_HALOS:-6}" ]; then
     echo "suite complete"
