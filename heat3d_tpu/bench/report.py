@@ -24,7 +24,13 @@ END = "<!-- measured:end -->"
 
 def load_results(path: str) -> List[Dict]:
     out = []
-    with open(path) as f:
+    try:
+        f = open(path)
+    except OSError:
+        # an APPEND session whose every row skipped never creates the
+        # record file; that's the zero-row case, not an error
+        return out
+    with f:
         for line in f:
             line = line.strip()
             if not line:
@@ -203,9 +209,26 @@ def render(results: List[Dict]) -> str:
     return "\n".join(lines)
 
 
-def update_baseline_md(results: List[Dict], baseline_path: str) -> None:
+def update_baseline_md(results: List[Dict], baseline_path: str) -> bool:
+    """Rewrite the measured block; returns False when it was left alone.
+
+    The block always mirrors the LIVE result record — a partial session's
+    rows legitimately replace older tables (prior records live in git and
+    the bench_results_r2.jsonl archive). The only refused rewrite is the
+    zero-row one: a session whose every row skipped (wedged tunnel)
+    carries no data at all, so erasing real tables for a placeholder
+    would be pure loss."""
     with open(baseline_path) as f:
         text = f.read()
+    if not results and BEGIN in text and END in text:
+        existing = text.split(BEGIN)[1].split(END)[0]
+        if existing.strip() and "(no benchmark results found)" not in existing:
+            print(
+                f"report: no results — keeping {baseline_path}'s existing "
+                "measured block",
+                file=sys.stderr,
+            )
+            return False
     block = f"{BEGIN}\n\n{render(results)}{END}"
     if BEGIN in text and END in text:
         pre = text.split(BEGIN)[0]
@@ -215,6 +238,7 @@ def update_baseline_md(results: List[Dict], baseline_path: str) -> None:
         text = text.rstrip() + "\n\n## Measured results\n\n" + block + "\n"
     with open(baseline_path, "w") as f:
         f.write(text)
+    return True
 
 
 def main(argv=None) -> int:
@@ -225,9 +249,10 @@ def main(argv=None) -> int:
     results_path = argv[0]
     baseline = argv[1] if len(argv) > 1 else "BASELINE.md"
     results = load_results(results_path)
-    update_baseline_md(results, baseline)
+    updated = update_baseline_md(results, baseline)
+    verb = "updated" if updated else "kept (no results)"
     print(
-        f"updated {baseline}: {len(results)} results "
+        f"{verb} {baseline}: {len(results)} results "
         f"({sum(r['bench'] == 'throughput' for r in results)} throughput, "
         f"{sum(r['bench'] == 'halo' for r in results)} halo)"
     )

@@ -182,4 +182,6 @@ if [[ -z "${SKIP_OVERLAP:-}" ]]; then
   fi
 fi
 
+# report refuses a zero-row rewrite itself (update_baseline_md), so a
+# session whose every row skipped leaves the committed tables untouched
 python -m heat3d_tpu.bench.report "$OUT" "$REPORT_MD"

@@ -117,6 +117,24 @@ def test_report_route_column():
     assert "direct chain(15)" in text
 
 
+def test_report_empty_results_keep_measured_block(tmp_path):
+    """A rowless session (every row skipped on a wedged tunnel) must not
+    erase the committed measured tables."""
+    from heat3d_tpu.bench import report
+
+    md = tmp_path / "B.md"
+    md.write_text(
+        f"# B\n\n{report.BEGIN}\n\n### Throughput (measured)\n\n"
+        f"| real measured row |\n{report.END}\n"
+    )
+    report.update_baseline_md([], str(md))
+    assert "real measured row" in md.read_text()
+    # an already-empty block still renders the placeholder
+    md.write_text(f"# B\n\n{report.BEGIN}\n{report.END}\n")
+    report.update_baseline_md([], str(md))
+    assert "(no benchmark results found)" in md.read_text()
+
+
 def test_ab_decide_pairs_and_thresholds(tmp_path):
     """scripts/ab_decide.py pairs rows differing in exactly one knob,
     scopes to the LAST session by default, and thresholds small wins."""
