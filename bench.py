@@ -227,14 +227,14 @@ def _measure_in_child(grid_edge=None, cpu=False, last_rung=False):
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        proc.terminate()
-        how = "terminated gracefully (claim released)"
-        try:
-            stdout, stderr = proc.communicate(timeout=grace)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            stdout, stderr = proc.communicate()
-            how = "SIGKILLed after ignoring SIGTERM — any chip claim is stale"
+        from heat3d_tpu.utils.backendprobe import stop_gracefully
+
+        stdout, stderr, killed = stop_gracefully(proc, grace)
+        how = (
+            "SIGKILLed after ignoring SIGTERM — any chip claim is stale"
+            if killed
+            else "terminated gracefully (claim released)"
+        )
         if stderr:
             sys.stderr.write(stderr)
         raise RuntimeError(

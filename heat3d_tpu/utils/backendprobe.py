@@ -58,17 +58,30 @@ def _probe(code: str, timeout: Optional[float]) -> Optional[str]:
     try:
         out, _ = proc.communicate(timeout=budget)
     except subprocess.TimeoutExpired:
-        proc.terminate()
-        try:
-            proc.communicate(timeout=15.0)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.communicate()
+        stop_gracefully(proc)
         return None
     if proc.returncode != 0:
         return None
     lines = out.strip().splitlines()
     return lines[-1] if lines else None
+
+
+def stop_gracefully(proc, grace: float = 15.0):
+    """TERM, wait ``grace`` for cleanup (claim release), KILL as backstop.
+
+    The one implementation of the stop-a-chip-claiming-child protocol —
+    shared by the probes and bench.py's measurement rungs. Returns
+    ``(stdout, stderr, killed)``; ``killed`` True means the child ignored
+    SIGTERM (stuck in a non-returning C call) and any claim it held is
+    stale."""
+    proc.terminate()
+    try:
+        out, err = proc.communicate(timeout=grace)
+        return out, err, False
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        return out, err, True
 
 
 def probe_platform(timeout: Optional[float] = None) -> Optional[str]:

@@ -28,10 +28,11 @@ def _sigterm_ends_session(signum, frame):
     pytest.exit("SIGTERM — releasing backend and ending session", returncode=3)
 
 
-if __import__("threading").current_thread() is __import__("threading").main_thread():
-    __import__("signal").signal(
-        __import__("signal").SIGTERM, _sigterm_ends_session
-    )
+import signal  # noqa: E402
+import threading  # noqa: E402
+
+if threading.current_thread() is threading.main_thread():
+    signal.signal(signal.SIGTERM, _sigterm_ends_session)
 
 
 @pytest.fixture(scope="session")
