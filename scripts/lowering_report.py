@@ -117,6 +117,11 @@ def main(argv=None) -> int:
         "local wraps/BC fills), independent of grid size; tb=2 supersteps",
         "exchange width-2 ghosts in the same 2-per-axis pattern.",
         "",
+        "Beyond compile-only: the judged pod topologies also EXECUTE at",
+        "tiny scale on virtual CPU meshes — (4,4,4) over 64 devices and",
+        "(8,4,4) over 128 — bitwise-matching the undecomposed run",
+        "(tests/test_multidevice.py::test_judged_pod_topology_executes).",
+        "",
         "| Config | Judged grid | Lowered grid | Mesh | Chips | Stencil |"
         " Dtype | tb | collective_permute | all_reduce |",
         "|---|---|---|---|---|---|---|---|---|---|",
