@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # One-shot TPU measurement session (run when the axon tunnel is healthy):
 #   1. <2-min smoke tier (compiled kernels sane on chip)
-#   2. benchmark suite -> bench_results.jsonl + BASELINE.md measured tables
-#   3. headline bench.py JSON line (judged config, best settings)
-#   4. profile trace + device-time summary at 512^3 tb=1 and tb=2
+#   2. headline bench.py JSON line (judged config, best settings — FIRST,
+#      so a short healthy window lands the judged metric before anything)
+#   3. benchmark suite -> bench_results.jsonl + BASELINE.md measured tables
+#   4. A/B stages + profile traces + ab_decide decisions
 #
 # Everything appends to $LOG so a wedged tunnel mid-run still leaves the
 # completed stages' records on disk.
@@ -39,18 +40,21 @@ fi
 echo "--- stage 1: smoke tier" | tee -a "$LOG"
 timeout -k 30 900 python -m pytest tests/ -m tpu_smoke -q 2>&1 | tail -3 | tee -a "$LOG"
 
-echo "--- stage 2: bench suite" | tee -a "$LOG"
-# The suite probe-gates each row internally; its stderr log (suite: ...
-# skip/fail lines + row tracebacks) is bench_results.err.log.
-timeout -k 30 "${SUITE_TIMEOUT:-7200}" bash scripts/run_bench_suite.sh \
-  bench_results.jsonl 2>&1 | tail -3 | tee -a "$LOG"
-
-echo "--- stage 3: headline bench" | tee -a "$LOG"
+# The headline comes BEFORE the full suite: if the healthy window is
+# short, the judged metric's own line must land first, not after two
+# hours of 256^3 rows.
+echo "--- stage 2: headline bench" | tee -a "$LOG"
 # outer timeout > bench.py's internal deadline (default 1500 s, which now
 # includes up to ~900 s of claim-outlasting probes) so the JSON line always
 # lands before SIGKILL
 wait_tpu "headline bench" \
   && timeout -k 30 1800 python bench.py 2>&1 | tee -a "$LOG"
+
+echo "--- stage 3: bench suite" | tee -a "$LOG"
+# The suite probe-gates each row internally; its stderr log (suite: ...
+# skip/fail lines + row tracebacks) is bench_results.err.log.
+timeout -k 30 "${SUITE_TIMEOUT:-7200}" bash scripts/run_bench_suite.sh \
+  bench_results.jsonl 2>&1 | tail -3 | tee -a "$LOG"
 
 echo "--- stage 3b: direct/exchange/conv A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
 # conv = one XLA conv_general_dilated (MXU) — the obvious XLA-native
@@ -68,7 +72,7 @@ for mode in direct exchange conv; do
 done
 
 # The factored-default 27pt and bf16-compute rows are already in the
-# suite record (stage 2); these A/B stages log the counterfactual sides.
+# suite record (stage 3); these A/B stages log the counterfactual sides.
 echo "--- stage 3c: 27pt y-factoring A/B (512^3 fp32)" | tee -a "$LOG"
 for fy in 1 0; do
   for tb in 1 2; do
@@ -84,7 +88,7 @@ echo "--- stage 3d: bf16-compute A/B (1024^3 tb=2)" | tee -a "$LOG"
 # storage/compute grid: bf16/fp32 vs bf16/bf16 answers whether the bf16
 # tb=2 ceiling gap is VPU-width-bound; fp32/bf16 runs the same width A/B
 # on the fp32 traffic shape (accuracy gates: tests/test_solver.py bf16
-# tiers). fp32/fp32 is the committed headline row (suite stage 2).
+# tiers). fp32/fp32 is the committed headline row (suite stage 3).
 for dt in "bf16 fp32" "bf16 bf16" "fp32 bf16"; do
   read -r st cd <<<"$dt"
   wait_tpu "compute A/B $st/$cd" || continue
@@ -132,7 +136,7 @@ GRID=512 STEPS=20 TB=1 STENCIL=27pt timeout -k 30 1200 \
   bash scripts/profile_bench.sh "/tmp/heat3d_profile_27pt" 2>&1 \
   | tee -a "$LOG"
 
-# halo p50 rows (device-side k-exchange loop) come from stage 2's suite:
+# halo p50 rows (device-side k-exchange loop) come from stage 3's suite:
 # one row per (grid, dtype) exchange shape, labeled local-only on the
 # single-chip mesh — the ICI numbers need a pod slice.
 
