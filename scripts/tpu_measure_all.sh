@@ -135,6 +135,12 @@ wait_tpu "profile 27pt" && \
 GRID=512 STEPS=20 TB=1 STENCIL=27pt timeout -k 30 1200 \
   bash scripts/profile_bench.sh "/tmp/heat3d_profile_27pt" 2>&1 \
   | tee -a "$LOG"
+# bf16 tb=2 ceiling question (32-43% of traffic ceiling): the trace shows
+# whether the fused sweep's extra time is VPU ops or VMEM plane assembly
+wait_tpu "profile bf16 tb=2" && \
+GRID=512 STEPS=20 TB=2 DTYPE=bf16 timeout -k 30 1200 \
+  bash scripts/profile_bench.sh "/tmp/heat3d_profile_bf16_tb2" 2>&1 \
+  | tee -a "$LOG"
 
 # halo p50 rows (device-side k-exchange loop) come from stage 3's suite:
 # one row per (grid, dtype) exchange shape, labeled local-only on the
