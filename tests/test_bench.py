@@ -387,8 +387,10 @@ def test_best_committed_tpu_record_filters(tmp_path):
     p.write_text("\n".join(json.dumps(r) for r in rows))
     best = bench._best_committed_tpu_record(str(p))
     assert best == {
-        "gcell_per_sec_per_chip": 103.1, "grid": 1024,
-        "dtype": "float32", "time_blocking": 2,
+        "fp32": {
+            "gcell_per_sec_per_chip": 103.1, "grid": 1024,
+            "dtype": "float32", "time_blocking": 2,
+        }
     }
     assert bench._best_committed_tpu_record(str(tmp_path / "nope")) is None
 
@@ -415,4 +417,4 @@ def test_best_committed_tpu_record_skips_malformed(tmp_path):
                     "gcell_per_sec_per_chip": 84.5}),
     ]))
     best = bench._best_committed_tpu_record(str(p))
-    assert best["gcell_per_sec_per_chip"] == 84.5
+    assert best["fp32"]["gcell_per_sec_per_chip"] == 84.5
