@@ -237,6 +237,22 @@ def _measure_in_child(grid_edge=None, cpu=False, last_rung=False):
         )
         if stderr:
             sys.stderr.write(stderr)
+        # A child that finished between the timeout firing and the TERM
+        # landing has already printed its result line — salvage it rather
+        # than discarding a valid measurement and burning a retry.
+        if stdout:
+            try:
+                rec = json.loads(stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                rec = None
+            if isinstance(rec, dict) and "value" in rec:
+                detail = rec.setdefault("detail", {})
+                detail["timed_out_after_result"] = round(timeout, 1)
+                # keep the claim diagnostic the raise would have carried: a
+                # SIGKILLed child's chip claim is stale and explains later
+                # rungs wedging
+                detail["child_stop"] = how
+                return rec
         raise RuntimeError(
             f"measurement child timed out after {timeout:.0f}s ({how})"
         ) from None

@@ -110,6 +110,60 @@ def _saved_blocks(path: str, ndim: int, allowed=None):
     return blocks
 
 
+def _resolve_shard(path, shape, dtype_str, allowed, blocks, index):
+    """Read the shard ``index`` selects, from its exactly-matching saved
+    file when the manifest trusts it, else stitched from overlapping
+    saved blocks. Returns ``(value, blocks)`` so the caller can reuse the
+    lazily-scanned block list across shards."""
+    start = _index_start(index, shape)
+    want = tuple(
+        (0 if sl.stop is None else sl.stop) - (0 if sl.start is None else sl.start)
+        for sl, n in zip(index, shape)
+    )
+    # normalize: slices with stop=None mean full axis
+    want = tuple(
+        n if (sl.start is None and sl.stop is None) else w
+        for sl, n, w in zip(index, shape, want)
+    )
+    fname = os.path.join(path, _shard_filename(start))
+    if (allowed is None or start in allowed) and os.path.exists(fname):
+        # mmap probe: the header check must not pay a full read of a
+        # wrong-shape block (the stitch below re-reads it lazily)
+        arr = np.load(fname, mmap_mode="r")
+        if arr.shape == want:
+            return _from_saved(np.array(arr), dtype_str), blocks
+    # cross-mesh resume: stitch this shard from overlapping saved blocks
+    if blocks is None:
+        blocks = _saved_blocks(path, len(shape), allowed)
+    out = None
+    filled = np.zeros(want, dtype=bool)
+    for bstart, bshape, bfn in blocks:
+        lo = tuple(max(s, bs) for s, bs in zip(start, bstart))
+        hi = tuple(
+            min(s + w, bs + bw)
+            for s, w, bs, bw in zip(start, want, bstart, bshape)
+        )
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        arr = np.load(os.path.join(path, bfn), mmap_mode="r")
+        if out is None:
+            out = np.empty(want, dtype=arr.dtype)
+        dst = tuple(slice(l - s, h - s) for l, h, s in zip(lo, hi, start))
+        src = tuple(slice(l - b, h - b) for l, h, b in zip(lo, hi, bstart))
+        out[dst] = arr[src]
+        filled[dst] = True
+    covered = int(np.count_nonzero(filled))  # mask: overlap-proof
+    if covered != int(np.prod(want)):
+        raise FileNotFoundError(
+            f"checkpoint {path}: saved blocks cover {covered} of "
+            f"{int(np.prod(want))} cells of the shard at {start} "
+            f"(shape {want}) — shard files missing or not visible to "
+            "this process (cross-mesh resume needs all overlapping "
+            "blocks readable; consolidate multi-host shards first)"
+        )
+    return _from_saved(out, dtype_str), blocks
+
+
 def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
     """Restore (field, step, extra) onto ``sharding``.
 
@@ -127,68 +181,32 @@ def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
     manifest = load_manifest(path)
     shape = tuple(manifest["global_shape"])
     dtype_str = manifest["dtype"]
+    listed = manifest.get("shards")
+    # Stale-shard gate: when the manifest records its save layout, ONLY
+    # the listed starts may be trusted — shard files from an earlier save
+    # on a different mesh match requested shapes exactly and would
+    # otherwise be silently mixed into the restored field.
+    allowed = {tuple(s) for s in listed} if listed else None
 
-    single = os.path.join(path, _shard_filename((0,) * len(shape)))
+    zero = (0,) * len(shape)
+    single = os.path.join(path, _shard_filename(zero))
     full = None
-    if os.path.exists(single):
-        arr = np.load(single)
+    if (allowed is None or zero in allowed) and os.path.exists(single):
+        # mmap header probe: a partial zero block (every multi-shard save
+        # has one) must not cost a full read just to fail the shape check
+        arr = np.load(single, mmap_mode="r")
         if arr.shape == shape:
-            full = _from_saved(arr, dtype_str)
+            full = _from_saved(np.array(arr), dtype_str)
     blocks = None  # scanned lazily, only when a cross-mesh stitch is needed
 
     def cb(index):
         if full is not None:
             return full[index]
-        start = _index_start(index, shape)
-        want = tuple(
-            (0 if sl.stop is None else sl.stop) - (0 if sl.start is None else sl.start)
-            for sl, n in zip(index, shape)
-        )
-        # normalize: slices with stop=None mean full axis
-        want = tuple(
-            n if (sl.start is None and sl.stop is None) else w
-            for sl, n, w in zip(index, shape, want)
-        )
-        fname = os.path.join(path, _shard_filename(start))
-        if os.path.exists(fname):
-            # mmap probe: the header check must not pay a full read of a
-            # wrong-shape block (the stitch below re-reads it lazily)
-            arr = np.load(fname, mmap_mode="r")
-            if arr.shape == want:
-                return _from_saved(np.array(arr), dtype_str)
-        # cross-mesh resume: stitch this shard from overlapping saved blocks
         nonlocal blocks
-        if blocks is None:
-            listed = manifest.get("shards")
-            allowed = {tuple(s) for s in listed} if listed else None
-            blocks = _saved_blocks(path, len(shape), allowed)
-        out = None
-        filled = np.zeros(want, dtype=bool)
-        for bstart, bshape, bfn in blocks:
-            lo = tuple(max(s, bs) for s, bs in zip(start, bstart))
-            hi = tuple(
-                min(s + w, bs + bw)
-                for s, w, bs, bw in zip(start, want, bstart, bshape)
-            )
-            if any(l >= h for l, h in zip(lo, hi)):
-                continue
-            arr = np.load(os.path.join(path, bfn), mmap_mode="r")
-            if out is None:
-                out = np.empty(want, dtype=arr.dtype)
-            dst = tuple(slice(l - s, h - s) for l, h, s in zip(lo, hi, start))
-            src = tuple(slice(l - b, h - b) for l, h, b in zip(lo, hi, bstart))
-            out[dst] = arr[src]
-            filled[dst] = True
-        covered = int(np.count_nonzero(filled))  # mask: overlap-proof
-        if covered != int(np.prod(want)):
-            raise FileNotFoundError(
-                f"checkpoint {path}: saved blocks cover {covered} of "
-                f"{int(np.prod(want))} cells of the shard at {start} "
-                f"(shape {want}) — shard files missing or not visible to "
-                "this process (cross-mesh resume needs all overlapping "
-                "blocks readable; consolidate multi-host shards first)"
-            )
-        return _from_saved(out, dtype_str)
+        value, blocks = _resolve_shard(
+            path, shape, dtype_str, allowed, blocks, index
+        )
+        return value
 
     u = jax.make_array_from_callback(shape, sharding, cb)
     return u, int(manifest["step"]), manifest.get("extra", {})
@@ -216,39 +234,110 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
     blocks = _saved_blocks(path, len(shape), allowed)
     if not blocks:
         raise FileNotFoundError(f"checkpoint {path}: no shard files found")
-    out = None
-    filled = np.zeros(shape, dtype=bool)
-    for bstart, bshape, bfn in blocks:
-        arr = np.load(os.path.join(path, bfn), mmap_mode="r")
-        if out is None:
-            out = np.empty(shape, dtype=arr.dtype)
-        dst = tuple(slice(b, b + w) for b, w in zip(bstart, bshape))
-        out[dst] = arr
-        filled[dst] = True
-    covered = int(np.count_nonzero(filled))
-    if covered != int(np.prod(shape)):
-        raise FileNotFoundError(
-            f"checkpoint {path}: saved blocks cover {covered} of "
-            f"{int(np.prod(shape))} cells — copy every host's shard files "
-            "into this directory before consolidating"
-        )
+    zero_start = (0,) * len(shape)
+    already_full = [
+        b for b in blocks if b[0] == zero_start and b[1] == shape
+    ]
+    # A listed full-shape zero block means the merge itself already
+    # happened — including the crashed-between-replaces case where the
+    # data landed but the manifest rewrite didn't (re-running consolidate
+    # is the recovery path, and the partial old blocks would otherwise
+    # trip the overlap check below).
+    if already_full:
+        blocks = already_full
+    else:
+        # Coverage check done geometrically (clipped volumes + pairwise
+        # overlap) rather than with a full-grid bool mask: at the pod
+        # scales this tool exists for (4096^3) a mask alone is 64 GiB of
+        # host RAM. Blocks reaching past the global shape are rejected,
+        # not clipped — the assembly below writes whole blocks.
+        total = int(np.prod(shape))
+        covered = 0
+        clipped = []
+        for bstart, bshape, bfn in blocks:
+            lo, hi = bstart, tuple(b + w for b, w in zip(bstart, bshape))
+            if any(l < 0 or h > n for l, h, n in zip(lo, hi, shape)):
+                raise ValueError(
+                    f"checkpoint {path}: block {bfn} spans {lo}..{hi}, "
+                    f"outside the manifest shape {shape} — stale file from "
+                    "a different-grid save; remove it or list 'shards' in "
+                    "the manifest"
+                )
+            clipped.append((lo, hi))
+            covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
+        for i in range(len(clipped)):
+            for j in range(i + 1, len(clipped)):
+                (alo, ahi), (blo, bhi) = clipped[i], clipped[j]
+                if all(max(al, bl) < min(ah, bh)
+                       for al, ah, bl, bh in zip(alo, ahi, blo, bhi)):
+                    raise ValueError(
+                        f"checkpoint {path}: saved blocks at {clipped[i][0]} "
+                        f"and {clipped[j][0]} overlap — directory mixes saves "
+                        "from different meshes; re-save or add a 'shards' "
+                        "manifest"
+                    )
+        if covered != total:
+            raise FileNotFoundError(
+                f"checkpoint {path}: saved blocks cover {covered} of "
+                f"{total} cells — copy every host's shard files "
+                "into this directory before consolidating"
+            )
     dest = out_path or path
     # realpath, not string, equality: `-o /ck/` (trailing slash, relative
     # spelling, symlink) naming the input must behave as in-place — delete
     # the replaced shard files — not as a broken hybrid of both modes
     in_place = os.path.realpath(dest) == os.path.realpath(path)
     os.makedirs(dest, exist_ok=True)
-    np.save(os.path.join(dest, _shard_filename((0,) * len(shape))), out)
+    zero_name = _shard_filename((0,) * len(shape))
+    final = os.path.join(dest, zero_name)
+    if already_full and in_place:
+        pass  # merged data already sits at `final`; don't recopy 256 GiB
+    else:
+        tmp_data = final + ".tmp"
+        # Assemble straight into an on-disk memmap (not host RAM — a
+        # 4096^3 fp32 field is 256 GiB) under a .tmp name; os.replace
+        # makes the data write as atomic as the manifest's, so a crash
+        # mid-consolidation never leaves a truncated zero-block shadowing
+        # good shard files.
+        out = np.lib.format.open_memmap(
+            tmp_data, mode="w+",
+            dtype=np.load(
+                os.path.join(path, blocks[0][2]), mmap_mode="r"
+            ).dtype,
+            shape=shape,
+        )
+        try:
+            for bstart, bshape, bfn in blocks:
+                arr = np.load(os.path.join(path, bfn), mmap_mode="r")
+                dst = tuple(slice(b, b + w) for b, w in zip(bstart, bshape))
+                out[dst] = arr
+            out.flush()
+        finally:
+            del out
+        os.replace(tmp_data, final)
     manifest["shards"] = [[0] * len(shape)]
     tmp = os.path.join(dest, MANIFEST + ".tmp")
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=2)
     os.replace(tmp, os.path.join(dest, MANIFEST))
+    # Source shards are deleted only after BOTH the data and manifest
+    # replaces have landed — any earlier failure leaves the input loadable.
+    # The sweep covers EVERY parseable shard file, not just manifest-listed
+    # ones: after the replaces the manifest is the sole source of truth
+    # ([[0,...,0]]), so unlisted files — prior-save strays, or partials a
+    # crash mid-sweep orphaned before a recovery re-run — are dead weight
+    # the load path can never read.
     if in_place:
-        zero = _shard_filename((0,) * len(shape))
-        for _, _, bfn in blocks:
-            if bfn != zero:
-                os.remove(os.path.join(path, bfn))
+        for fn in os.listdir(path):
+            if fn == zero_name or not (
+                fn.startswith("shard_") and fn.endswith(".npy")
+            ):
+                continue
+            try:
+                [int(x) for x in fn[len("shard_"):-len(".npy")].split("_")]
+            except ValueError:
+                continue  # not one of ours — leave it
+            os.remove(os.path.join(path, fn))
     return dest
 
 

@@ -212,6 +212,46 @@ def test_checkpoint_cross_mesh_resume(tmp_path):
         solver.load_checkpoint(str(path))
 
 
+def test_checkpoint_stale_exact_match_ignored(tmp_path):
+    """Save on mesh A, save NEW data on mesh B into the same directory,
+    resume on mesh A: the stale mesh-A shard file at a start the current
+    manifest does not list matches the requested shape exactly, and must
+    NOT be trusted by the exact-match fast path (regression: advisor
+    round-3 medium finding) — the shard is stitched from listed blocks."""
+    from heat3d_tpu.utils import checkpoint as ckpt
+
+    rng = np.random.default_rng(3)
+    old = rng.standard_normal((16, 16, 16)).astype(np.float32)
+    new = rng.standard_normal((16, 16, 16)).astype(np.float32)
+    path = tmp_path / "ckstale"
+    path.mkdir()
+    # mesh A = (1,1,2): z-split save of OLD data
+    np.save(path / ckpt._shard_filename((0, 0, 0)), old[:, :, :8])
+    np.save(path / ckpt._shard_filename((0, 0, 8)), old[:, :, 8:])
+    # mesh B = (1,1,1): full-block save of NEW data; shard_0_0_0 is
+    # overwritten, shard_0_0_8 is left stale, manifest lists only [0,0,0]
+    np.save(path / ckpt._shard_filename((0, 0, 0)), new)
+    (path / ckpt.MANIFEST).write_text(json.dumps({
+        "step": 9, "global_shape": [16, 16, 16], "dtype": "float32",
+        "format": 1, "shards": [[0, 0, 0]], "extra": {},
+    }))
+    # resume on mesh A: the (0,0,8) request exactly matches the stale file
+    idx = (slice(0, 16), slice(0, 16), slice(8, 16))
+    val, _ = ckpt._resolve_shard(
+        str(path), (16, 16, 16), "float32", {(0, 0, 0)}, None, idx
+    )
+    np.testing.assert_array_equal(val, new[:, :, 8:])
+    # the full-block fast path is gated the same way: a manifest NOT
+    # listing (0,0,0) must not trust a full-shape shard_0_0_0 file
+    (path / ckpt.MANIFEST).write_text(json.dumps({
+        "step": 9, "global_shape": [16, 16, 16], "dtype": "float32",
+        "format": 1, "shards": [[0, 0, 8]], "extra": {},
+    }))
+    with pytest.raises(FileNotFoundError, match="cover"):
+        solver, _ = make_solver()
+        solver.load_checkpoint(str(path))
+
+
 def test_checkpoint_consolidate(tmp_path):
     """consolidate merges a sharded save into the single-block layout (the
     multi-host gather-then-resume workflow), removing the listed shard
@@ -246,6 +286,51 @@ def test_checkpoint_consolidate(tmp_path):
     u, step = solver.load_checkpoint(str(path))
     assert step == 3
     np.testing.assert_array_equal(np.asarray(solver.gather(u)), full)
+
+
+def test_checkpoint_consolidate_rerun_recovers(tmp_path):
+    """A crash between consolidate's data replace and its manifest
+    replace leaves a full-shape zero block beside the still-listed
+    partial blocks; re-running consolidate must finish the job (adopt the
+    merged block, rewrite the manifest, sweep the partials) instead of
+    tripping the overlap check (regression: round-4 review finding)."""
+    from heat3d_tpu.utils import checkpoint as ckpt
+
+    rng = np.random.default_rng(13)
+    full = rng.standard_normal((16, 16, 16)).astype(np.float32)
+    path = tmp_path / "ckcrash"
+    path.mkdir()
+    # simulate the post-crash state: data replace landed (zero block is
+    # the full merge), manifest still lists the old (1,1,2) partials
+    np.save(path / ckpt._shard_filename((0, 0, 0)), full)
+    np.save(path / ckpt._shard_filename((0, 0, 8)), full[:, :, 8:])
+    (path / ckpt.MANIFEST).write_text(json.dumps({
+        "step": 4, "global_shape": [16, 16, 16], "dtype": "float32",
+        "format": 1, "shards": [[0, 0, 0], [0, 0, 8]], "extra": {},
+    }))
+    dest = ckpt.consolidate(str(path))
+    assert ckpt.load_manifest(dest)["shards"] == [[0, 0, 0]]
+    assert sorted(f for f in os.listdir(path) if f.endswith(".npy")) == \
+        [ckpt._shard_filename((0, 0, 0))]
+    np.testing.assert_array_equal(
+        np.load(path / ckpt._shard_filename((0, 0, 0))), full)
+    # crash later still — after the manifest replace, mid-deletion-sweep:
+    # the manifest now lists only [[0,0,0]] but an orphaned partial
+    # survives; a re-run must sweep it even though it's unlisted
+    np.save(path / ckpt._shard_filename((0, 0, 8)), full[:, :, 8:])
+    ckpt.consolidate(str(path))
+    assert sorted(f for f in os.listdir(path) if f.endswith(".npy")) == \
+        [ckpt._shard_filename((0, 0, 0))]
+    # a genuinely out-of-range stale block (different-grid save, no
+    # 'shards' list to exclude it) is rejected, not clipped-then-crashed
+    np.save(path / ckpt._shard_filename((0, 0, 12)),
+            np.zeros((16, 16, 8), np.float32))
+    (path / ckpt.MANIFEST).write_text(json.dumps({
+        "step": 4, "global_shape": [16, 16, 8], "dtype": "float32",
+        "format": 1, "extra": {},
+    }))
+    with pytest.raises(ValueError, match="outside the manifest shape"):
+        ckpt.consolidate(str(path))
 
 
 def test_cli_exact_step_count_and_periodic_checkpoint(tmp_path, capsys):
