@@ -40,6 +40,16 @@ def _shard_filename(start: Tuple[int, ...]) -> str:
     return "shard_" + "_".join(str(s) for s in start) + ".npy"
 
 
+def _parse_shard_start(fn: str) -> Optional[Tuple[int, ...]]:
+    """Inverse of ``_shard_filename``; None for files that aren't ours."""
+    if not (fn.startswith("shard_") and fn.endswith(".npy")):
+        return None
+    try:
+        return tuple(int(x) for x in fn[len("shard_"):-len(".npy")].split("_"))
+    except ValueError:
+        return None
+
+
 def _index_start(index, shape) -> Tuple[int, ...]:
     return tuple(0 if sl.start is None else int(sl.start) for sl in index)
 
@@ -95,13 +105,8 @@ def _saved_blocks(path: str, ndim: int, allowed=None):
     every shard file is trusted."""
     blocks = []
     for fn in sorted(os.listdir(path)):
-        if not (fn.startswith("shard_") and fn.endswith(".npy")):
-            continue
-        try:
-            start = tuple(int(x) for x in fn[len("shard_"):-len(".npy")].split("_"))
-        except ValueError:
-            continue
-        if len(start) != ndim:
+        start = _parse_shard_start(fn)
+        if start is None or len(start) != ndim:
             continue
         if allowed is not None and start not in allowed:
             continue
@@ -238,12 +243,36 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
     already_full = [
         b for b in blocks if b[0] == zero_start and b[1] == shape
     ]
-    # A listed full-shape zero block means the merge itself already
-    # happened — including the crashed-between-replaces case where the
-    # data landed but the manifest rewrite didn't (re-running consolidate
-    # is the recovery path, and the partial old blocks would otherwise
-    # trip the overlap check below).
+    # A full-shape zero block beside still-listed partials USUALLY means a
+    # consolidate crashed between its data replace and its manifest
+    # replace, and this re-run is the recovery. But the same file shape
+    # can be a STALE consolidated save in a directory a newer sharded
+    # save's files were copied into (with the new zero partial missing) —
+    # adopting that would resurrect old data and sweep the fresh partials.
+    # Discriminate by content: a genuine recovery's full block was merged
+    # FROM the surviving partials, so each must equal its region of it.
     if already_full:
+        fullmap = np.load(
+            os.path.join(path, already_full[0][2]), mmap_mode="r"
+        )
+        for bstart, bshape, bfn in blocks:
+            if bstart == zero_start and bshape == shape:
+                continue
+            region = tuple(
+                slice(b, b + w) for b, w in zip(bstart, bshape)
+            )
+            part = np.load(os.path.join(path, bfn), mmap_mode="r")
+            if fullmap[region].shape != part.shape or not np.array_equal(
+                fullmap[region], part
+            ):
+                raise ValueError(
+                    f"checkpoint {path}: full-shape {already_full[0][2]} "
+                    f"disagrees with listed partial {bfn} — the zero block "
+                    "is a stale consolidated save, not this save's merge; "
+                    "remove it (and re-copy the missing zero-start partial) "
+                    "before consolidating"
+                )
+        del fullmap
         blocks = already_full
     else:
         # Coverage check done geometrically (clipped volumes + pairwise
@@ -329,15 +358,8 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
     # the load path can never read.
     if in_place:
         for fn in os.listdir(path):
-            if fn == zero_name or not (
-                fn.startswith("shard_") and fn.endswith(".npy")
-            ):
-                continue
-            try:
-                [int(x) for x in fn[len("shard_"):-len(".npy")].split("_")]
-            except ValueError:
-                continue  # not one of ours — leave it
-            os.remove(os.path.join(path, fn))
+            if fn != zero_name and _parse_shard_start(fn) is not None:
+                os.remove(os.path.join(path, fn))
     return dest
 
 

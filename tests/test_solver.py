@@ -321,6 +321,20 @@ def test_checkpoint_consolidate_rerun_recovers(tmp_path):
     ckpt.consolidate(str(path))
     assert sorted(f for f in os.listdir(path) if f.endswith(".npy")) == \
         [ckpt._shard_filename((0, 0, 0))]
+    # the dangerous lookalike: a STALE consolidated full block beside a
+    # fresh sharded save whose zero partial never got copied in — content
+    # disagrees with the listed partials, so adoption must refuse rather
+    # than resurrect old data and sweep the fresh shards
+    stale = np.zeros((16, 16, 16), np.float32)
+    np.save(path / ckpt._shard_filename((0, 0, 0)), stale)
+    np.save(path / ckpt._shard_filename((0, 0, 8)), full[:, :, 8:])
+    (path / ckpt.MANIFEST).write_text(json.dumps({
+        "step": 7, "global_shape": [16, 16, 16], "dtype": "float32",
+        "format": 1, "shards": [[0, 0, 0], [0, 0, 8]], "extra": {},
+    }))
+    with pytest.raises(ValueError, match="stale consolidated save"):
+        ckpt.consolidate(str(path))
+    assert (path / ckpt._shard_filename((0, 0, 8))).exists()
     # a genuinely out-of-range stale block (different-grid save, no
     # 'shards' list to exclude it) is rejected, not clipped-then-crashed
     np.save(path / ckpt._shard_filename((0, 0, 12)),
