@@ -1,0 +1,385 @@
+"""Fused DMA-overlap stencil kernel: remote face copies + interior sweep +
+shell emission in ONE Pallas kernel (SURVEY.md §7.1 item 7).
+
+Reference parity (SURVEY.md §3.2 hot-spot analysis): the optimized CUDA
+variants of the reference class run the interior-update kernel on one
+stream while the halo faces exchange on another, then update the boundary.
+The ppermute transports get this overlap from XLA's async collectives (the
+faces-direct step); the RDMA transport (ops/halo_pallas) could not — its
+exchange kernel starts AND waits its DMAs before any compute runs. This
+kernel closes that gap for the slab-decomposed 7-point configs: the two
+x-face remote copies are issued at grid step 0, the streaming sweep then
+emits every x-interior output plane (1 .. nx-2) — which depend only on
+local planes — while the faces are in flight over ICI, and only the last
+few grid steps wait on the receive semaphores and emit the two shard-
+boundary planes. At 1024^3-scale shards the transfer (a few MB per face)
+hides under the multi-ms bulk sweep with three orders of magnitude of
+slack.
+
+The scheduling trick that keeps the kernel small: the sweep's 3-slot input
+ring treats the arriving ghost planes as ordinary planes of the stream.
+Step i <= nx-1 stores local plane i; step nx stores the HIGH ghost (acting
+as "plane nx", so emitting output nx-1 at step nx is the ring's standard
+emit); steps nx+2 / nx+3 re-load planes 0 / 1 around the LOW ghost stored
+at step nx+1, making output 0's emit at step nx+3 the same slot pattern
+{-1: (i+1)%3, 0: (i+2)%3, 1: i%3} as every other emission. One uniform
+emit path, outputs ordered interior-first — overlap falls out of the index
+maps instead of a second kernel.
+
+Scope (the dispatch gate `fused_dma_supported` enforces this): taps whose
+x-neighbor planes touch only the center cell (the 7-point family — a
+27-point x-plane needs edge/corner ghosts, which face-only transfers do
+not carry), a mesh sharded along axis 0 only (the judged 1D slab
+decomposition; y/z stay domain boundaries synthesized in-register exactly
+as ops/stencil_pallas_direct does), unpadded shards, nx >= 2. Must run
+inside shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from heat3d_tpu.core.stencils import effective_num_taps, flat_taps
+from heat3d_tpu.ops.stencil_pallas import _plane_taps
+from heat3d_tpu.ops.stencil_pallas_direct import (
+    _LANE,
+    _SUBLANE,
+    _chunk_ghost_rows,
+    _plane_bytes,
+    _row_block_specs,
+    _store_framed_plane,
+    choose_chunk,
+)
+
+# The two resident (ny, nz) ghost planes live OUTSIDE choose_chunk's
+# ring/pipeline budget; their own ceiling keeps the kernel's total VMEM
+# well inside the chip's (ghosts are 4 MB each at 1024^2 fp32).
+_GHOST_BUDGET = 16 * 1024 * 1024
+
+# collective_id: the per-axis halo kernels use 0..2; this kernel is its own
+# collective class.
+_COLLECTIVE_ID = 3
+
+
+def taps_faces_only(taps: np.ndarray) -> bool:
+    """True when every x-neighbor tap touches only the center of its plane
+    (di != 0 implies dj == dk == 0) — the structural property that lets
+    face-only ghost transfers feed a correct boundary-plane update."""
+    return all(
+        (dj, dk) == (0, 0)
+        for di, dj, dk, _ in flat_taps(taps)
+        if di != 0
+    )
+
+
+def fused_dma_supported(
+    local_shape: Tuple[int, int, int],
+    mesh_shape: Tuple[int, int, int],
+    taps: np.ndarray,
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    compute_itemsize: int = 4,
+) -> bool:
+    nx, ny, nz = local_shape
+    if nx < 2:
+        return False  # the re-loaded planes 0/1 must be distinct x-planes
+    if mesh_shape[0] < 2 or mesh_shape[1] != 1 or mesh_shape[2] != 1:
+        return False  # v1 scope: 1D slab decomposition along x
+    if not taps_faces_only(taps):
+        return False
+    if 2 * _plane_bytes(ny, nz, in_itemsize) > _GHOST_BUDGET:
+        return False
+    return (
+        choose_chunk(
+            local_shape, 1, in_itemsize, out_itemsize,
+            n_taps=effective_num_taps(taps),
+            compute_itemsize=compute_itemsize,
+        )
+        is not None
+    )
+
+
+def _fused_kernel(
+    u_win,
+    u_any,
+    top_ref,
+    bot_ref,
+    out_ref,
+    glo_ref,
+    ghi_ref,
+    ring,
+    send_sem,
+    recv_sem,
+    *,
+    taps_flat,
+    nx,
+    by,
+    nz,
+    n_chunks,
+    axis_name,
+    mesh_axes,
+    axis_size,
+    periodic,
+    bc_value,
+    compute_dtype,
+    out_dtype,
+    use_barrier,
+):
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    bc = u_win.dtype.type(bc_value)
+    my = lax.axis_index(axis_name)
+
+    def neighbor(delta):
+        idx = lax.rem(my + delta + axis_size, axis_size)
+        if len(mesh_axes) == 1:
+            return idx
+        return {axis_name: idx}
+
+    # Same symmetric ring shape as ops/halo_pallas._exchange_body: my high
+    # face -> hi neighbor's low-ghost buffer (its completion on MY
+    # recv_sem[0] is my LOW ghost arriving), and vice versa. Descriptors
+    # are rebuilt at each use site — they are just op emitters over the
+    # same refs and semaphores.
+    def copy_to_hi_neighbor():
+        return pltpu.make_async_remote_copy(
+            src_ref=u_any.at[nx - 1],
+            dst_ref=glo_ref,
+            send_sem=send_sem.at[0],
+            recv_sem=recv_sem.at[0],
+            device_id=neighbor(+1),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    def copy_to_lo_neighbor():
+        return pltpu.make_async_remote_copy(
+            src_ref=u_any.at[0],
+            dst_ref=ghi_ref,
+            send_sem=send_sem.at[1],
+            recv_sem=recv_sem.at[1],
+            device_id=neighbor(-1),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    @pl.when(jnp.logical_and(j == 0, i == 0))
+    def _start():
+        if use_barrier:
+            barrier = pltpu.get_barrier_semaphore()
+            for delta in (-1, +1):
+                pltpu.semaphore_signal(
+                    barrier,
+                    inc=1,
+                    device_id=neighbor(delta),
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+            pltpu.semaphore_wait(barrier, 2)
+        copy_to_hi_neighbor().start()
+        copy_to_lo_neighbor().start()
+
+    # Waits, placed AFTER the whole interior sweep: the hi ghost ("plane
+    # nx") is first read at step (0, nx), the lo ghost at (0, nx+1). Only
+    # chunk column 0 waits — the semaphores are consumed once; later
+    # columns read the already-landed buffers.
+    @pl.when(jnp.logical_and(j == 0, i == nx))
+    def _wait_hi():
+        # send_sem[1] + recv_sem[1]: my HIGH ghost has landed
+        copy_to_lo_neighbor().wait()
+
+    @pl.when(jnp.logical_and(j == 0, i == nx + 1))
+    def _wait_lo():
+        # send_sem[0] + recv_sem[0]: my LOW ghost has landed
+        copy_to_hi_neighbor().wait()
+
+    chunk = u_win[0]  # (by, nz)
+    top, bot = _chunk_ghost_rows(chunk, top_ref, bot_ref, 1, periodic, bc)
+    if not periodic:
+        top = jnp.where(j == 0, jnp.full_like(top, bc), top)
+        bot = jnp.where(j == n_chunks - 1, jnp.full_like(bot, bc), bot)
+
+    # Dirichlet domain edges: the torus-symmetric wrap transfer still
+    # arrives (and is waited, keeping the semaphores drained), but the
+    # ghost VALUES are the boundary condition.
+    is_lo_edge = jnp.logical_and(jnp.logical_not(periodic), my == 0)
+    is_hi_edge = jnp.logical_and(
+        jnp.logical_not(periodic), my == axis_size - 1
+    )
+
+    def ghost_chunk(ref, edge):
+        g = ref[pl.ds(j * by, by), :]
+        return jnp.where(edge, jnp.full_like(g, bc), g)
+
+    real_plane = i <= nx - 1
+    for k in range(3):
+
+        @pl.when(jnp.logical_and(real_plane, lax.rem(i, 3) == k))
+        def _store_local(k=k):
+            _store_framed_plane(ring, k, chunk, top, bot, bc, periodic, 1)
+
+    # Step nx: the HIGH ghost enters the ring as "plane nx"; step nx+1 the
+    # LOW ghost as the future "plane -1"; steps nx+2 / nx+3 re-load planes
+    # 0 / 1 (the window fetches them via the index map — `chunk` already
+    # holds the right data). Ghost planes only ever sit in a +-1 emit slot
+    # and faces-only taps read just their (by, nz) interior, so their
+    # frames are never consumed; the bc frame is arbitrary.
+    for k in range(3):
+
+        @pl.when(jnp.logical_and(i == nx, lax.rem(i, 3) == k))
+        def _store_hi(k=k):
+            _store_framed_plane(
+                ring, k, ghost_chunk(ghi_ref, is_hi_edge),
+                jnp.full_like(top, bc), jnp.full_like(bot, bc),
+                bc, False, 1,
+            )
+
+        @pl.when(jnp.logical_and(i == nx + 1, lax.rem(i, 3) == k))
+        def _store_lo(k=k):
+            _store_framed_plane(
+                ring, k, ghost_chunk(glo_ref, is_lo_edge),
+                jnp.full_like(top, bc), jnp.full_like(bot, bc),
+                bc, False, 1,
+            )
+
+        @pl.when(jnp.logical_and(i >= nx + 2, lax.rem(i, 3) == k))
+        def _store_reload(k=k):
+            _store_framed_plane(ring, k, chunk, top, bot, bc, periodic, 1)
+
+    # Uniform emission: planes (i-2, i-1, i) live in slots ((k+1)%3,
+    # (k+2)%3, k) for every emitting step — interior outputs i-1 at
+    # i in [2, nx-1], output nx-1 at i == nx (hi ghost = plane nx), and
+    # output 0 at i == nx+3 (lo ghost / plane 0 / plane 1).
+    emit = jnp.logical_or(
+        jnp.logical_and(i >= 2, i <= nx), i == nx + 3
+    )
+    for k in range(3):
+
+        @pl.when(jnp.logical_and(emit, lax.rem(i, 3) == k))
+        def _emit(k=k):
+            slots = {-1: (k + 1) % 3, 0: (k + 2) % 3, 1: k}
+            planes = {
+                d: ring[s].astype(compute_dtype) for d, s in slots.items()
+            }
+            res = _plane_taps(planes, taps_flat, by, nz, compute_dtype)
+            out_ref[0] = res.astype(out_dtype)
+
+
+def apply_step_fused_dma(
+    u: jax.Array,
+    taps: np.ndarray,
+    *,
+    axis_name: str,
+    axis_size: int,
+    mesh_axes,
+    periodic: bool = False,
+    bc_value: float = 0.0,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """One stencil update of an x-slab shard with kernel-initiated halo
+    DMA overlapped under the sweep. Must run inside shard_map over a mesh
+    whose axis 0 has ``axis_size`` devices (axes 1/2 size 1)."""
+    nx, ny, nz = u.shape
+    out_dtype = out_dtype or u.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    flat = flat_taps(taps)
+    by = choose_chunk(
+        u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
+        n_taps=effective_num_taps(taps),
+        compute_itemsize=jnp.dtype(compute_dtype).itemsize,
+    )
+    if by is None:
+        raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
+    n_chunks = ny // by
+    single = n_chunks == 1
+
+    # Input plane fetched per step: local planes for the sweep, planes 0/1
+    # again for the final emit, in-range dummies on the ghost-store steps.
+    def x_of(i):
+        return jnp.where(
+            i <= nx - 1, i, jnp.clip(i - (nx + 2), 0, nx - 1)
+        )
+
+    # Output plane per step, shaped so every window run's LAST step is its
+    # write: i=0..1 idle under block 1 (written at i=2), interior writes
+    # i-1, block nx-1 written at i=nx, block 0 idle nx+1..nx+2 and written
+    # at nx+3.
+    def o_of(i):
+        return jnp.where(
+            i <= nx, jnp.clip(i - 1, 1, nx - 1), 0
+        )
+
+    kernel = functools.partial(
+        _fused_kernel if not single else _fused_kernel_single,
+        taps_flat=flat,
+        nx=nx,
+        by=by,
+        nz=nz,
+        n_chunks=n_chunks,
+        axis_name=axis_name,
+        mesh_axes=tuple(mesh_axes),
+        axis_size=axis_size,
+        periodic=periodic,
+        bc_value=bc_value,
+        compute_dtype=compute_dtype,
+        out_dtype=jnp.dtype(out_dtype),
+        use_barrier=not interpret,
+    )
+    in_specs = [
+        pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),  # DMA face source
+    ]
+    operands = (u, u)
+    if not single:
+        in_specs += _row_block_specs(x_of, by, ny, nz, periodic)
+        operands = (u, u, u, u)
+    out, _glo, _ghi = pl.pallas_call(
+        kernel,
+        grid=(n_chunks, nx + 4),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((1, by, nz), lambda j, i: (o_of(i), j, 0)),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+            jax.ShapeDtypeStruct((ny, nz), u.dtype),  # low ghost landing
+            jax.ShapeDtypeStruct((ny, nz), u.dtype),  # high ghost landing
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((3, by + 2, nz + 2), u.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=_COLLECTIVE_ID,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * len(flat) * nx * ny * nz,
+            bytes_accessed=nx * ny * nz
+            * (u.dtype.itemsize + jnp.dtype(out_dtype).itemsize),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out
+
+
+def _fused_kernel_single(
+    u_win, u_any, out_ref, glo_ref, ghi_ref, ring, send_sem, recv_sem,
+    **params,
+):
+    """Single-chunk-column variant: no ghost-row refs (derived in-kernel)."""
+    _fused_kernel(
+        u_win, u_any, None, None, out_ref, glo_ref, ghi_ref, ring,
+        send_sem, recv_sem, **params,
+    )

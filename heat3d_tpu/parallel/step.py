@@ -417,6 +417,71 @@ def _local_superstep_direct_faces(
     return out
 
 
+def _fused_dma_fn(cfg: SolverConfig):
+    """Return the fused DMA-overlap kernel entry for this config, or None.
+
+    The route exists for overlap=True on the RDMA transport (SURVEY.md
+    §7.1 item 7): one Pallas kernel issues the x-face remote copies, sweeps
+    every x-interior output plane while they fly, and waits only for the
+    two shard-boundary planes. Scope gates mirror the kernel's
+    (ops/stencil_dma_fused.fused_dma_supported): 7-point-family taps, 1D
+    x-slab mesh, unpadded shards."""
+    import os
+
+    if not (cfg.overlap and cfg.halo == "dma"):
+        return None
+    if cfg.backend not in ("pallas", "auto"):
+        return None
+    if cfg.is_padded:
+        return None
+    interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
+    forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))
+    if not interpret and not forced and jax.devices()[0].platform != "tpu":
+        return None
+    try:
+        from heat3d_tpu.ops.stencil_dma_fused import (
+            apply_step_fused_dma,
+            fused_dma_supported,
+        )
+    except ImportError:
+        return None
+    itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    if not fused_dma_supported(
+        cfg.local_shape,
+        cfg.mesh.shape,
+        _solver_taps(cfg),
+        itemsize,
+        itemsize,
+        jnp.dtype(cfg.precision.compute).itemsize,
+    ):
+        return None
+    import functools
+
+    if interpret:
+        return functools.partial(apply_step_fused_dma, interpret=True)
+    return apply_step_fused_dma
+
+
+def _local_step_fused_dma(
+    u_local: jax.Array,
+    taps: np.ndarray,
+    cfg: SolverConfig,
+    fused,
+) -> jax.Array:
+    out = fused(
+        u_local,
+        taps,
+        axis_name=cfg.mesh.axis_names[0],
+        axis_size=cfg.mesh.shape[0],
+        mesh_axes=cfg.mesh.axis_names,
+        periodic=cfg.stencil.bc is BoundaryCondition.PERIODIC,
+        bc_value=cfg.stencil.bc_value,
+        compute_dtype=jnp.dtype(cfg.precision.compute),
+        out_dtype=jnp.dtype(cfg.precision.storage),
+    )
+    return _pin_padding(out, cfg)
+
+
 def _local_step_overlap(
     u_local: jax.Array,
     taps: np.ndarray,
@@ -512,22 +577,35 @@ def make_step_fn(
                 return _local_step_direct_faces(u_local, taps, cfg, direct)
 
     if cfg.overlap and direct is None:
-        # jnp interior/boundary split — the portable overlap form; when the
-        # direct kernel dispatched above, the faces-direct step already
-        # overlaps the face ppermutes with the bulk sweep
-        if min(cfg.local_shape) < 3:
-            raise ValueError(
-                f"overlap=True needs local blocks >= 3 per axis to have an "
-                f"interior, got {cfg.local_shape}"
+        fused_dma = _fused_dma_fn(cfg)
+        if fused_dma is not None:
+            _log_step_path_once(
+                "step path: fused DMA-overlap kernel (remote face copies "
+                "under the sweep)"
             )
-        if cfg.halo == "dma":
-            raise ValueError(
-                "overlap=True requires halo='ppermute': the overlap comes "
-                "from XLA's async collective-permutes, which the "
-                "side-effecting DMA kernels do not participate in — the "
-                "combination would pay the split-step overhead for no overlap"
-            )
-        local_step = _local_step_overlap
+
+            def local_step(u_local, taps, cfg, compute_padded):
+                return _local_step_fused_dma(u_local, taps, cfg, fused_dma)
+
+        else:
+            # jnp interior/boundary split — the portable overlap form; when
+            # the direct kernel dispatched above, the faces-direct step
+            # already overlaps the face ppermutes with the bulk sweep
+            if min(cfg.local_shape) < 3:
+                raise ValueError(
+                    f"overlap=True needs local blocks >= 3 per axis to have "
+                    f"an interior, got {cfg.local_shape}"
+                )
+            if cfg.halo == "dma":
+                raise ValueError(
+                    "overlap=True with halo='dma' needs the fused "
+                    "DMA-overlap kernel (7-point-family stencil, 1D x-slab "
+                    "mesh with >= 2 devices, unpadded shards, TPU); outside "
+                    "that scope the side-effecting DMA exchange kernels "
+                    "cannot overlap with compute — use halo='ppermute' for "
+                    "XLA's async collective-permutes"
+                )
+            local_step = _local_step_overlap
 
     # check_vma=False: pallas_call inside shard_map would otherwise require a
     # `vma` annotation on its out_shape (jax 0.9), and the kernel is built

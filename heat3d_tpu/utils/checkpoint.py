@@ -262,8 +262,12 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
                 slice(b, b + w) for b, w in zip(bstart, bshape)
             )
             part = np.load(os.path.join(path, bfn), mmap_mode="r")
+            # equal_nan for float blocks (a diverged run's NaN cells must
+            # not fail its own recovery); ints (raw bf16 views) compare
+            # exactly and isnan would reject them
+            eq_nan = np.issubdtype(part.dtype, np.inexact)
             if fullmap[region].shape != part.shape or not np.array_equal(
-                fullmap[region], part
+                fullmap[region], part, equal_nan=eq_nan
             ):
                 raise ValueError(
                     f"checkpoint {path}: full-shape {already_full[0][2]} "

@@ -523,6 +523,62 @@ def check_dma_halo_ring_interpret():
     print("dma_halo_ring_interpret OK (axes 0-2, widths 1-3)")
 
 
+def check_fused_dma_overlap_ring_interpret():
+    """Fused DMA-overlap step (remote face copies issued at grid step 0,
+    interior sweep while in flight, boundary planes after the waits —
+    SURVEY.md §7.1 item 7) on a real 8-device ring == the single-device
+    oracle, both BCs, single- and multi-chunk-column modes. Runs on a 1D
+    named mesh for the same jax-0.9 interpret-mode reason as
+    check_dma_halo_ring_interpret; the production 3-axis-mesh dispatch is
+    covered by the TPU cross-lowering tests (tests/test_dma_fused.py)."""
+    from jax.sharding import Mesh, NamedSharding
+
+    import heat3d_tpu.ops.stencil_dma_fused as fused_mod
+    from heat3d_tpu.core.config import GridConfig
+    from heat3d_tpu.ops.stencil_jnp import step_single_device
+
+    grid = (16, 16, 16)
+    gc = GridConfig(shape=grid)
+    taps = stencil_taps(
+        STENCILS["7pt"], gc.alpha, gc.effective_dt(), gc.spacing
+    )
+    u_host = golden.random_init(grid, seed=31)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    spec = P("x")
+    u = jax.device_put(jnp.asarray(u_host), NamedSharding(mesh, spec))
+    orig_chunk = fused_mod.choose_chunk
+    try:
+        for by in (None, 8):  # None = real chooser (single chunk), 8 = 2 chunks
+            if by is not None:
+                fused_mod.choose_chunk = lambda *a, _by=by, **k: _by
+            else:
+                fused_mod.choose_chunk = orig_chunk
+            for bc, bcv in [
+                (BoundaryCondition.DIRICHLET, 1.5),
+                (BoundaryCondition.PERIODIC, 0.0),
+            ]:
+                periodic = bc is BoundaryCondition.PERIODIC
+                got = jax.jit(
+                    jax.shard_map(
+                        lambda x, p=periodic, v=bcv: fused_mod.apply_step_fused_dma(
+                            x, taps, axis_name="x", axis_size=8,
+                            mesh_axes=("x",), periodic=p, bc_value=v,
+                            interpret=True,
+                        ),
+                        mesh=mesh, in_specs=spec, out_specs=spec,
+                        check_vma=False,
+                    )
+                )(u)
+                want = step_single_device(jnp.asarray(u_host), taps, bc, bcv)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
+                    err_msg=f"by={by} bc={bc} bcv={bcv}",
+                )
+    finally:
+        fused_mod.choose_chunk = orig_chunk
+    print("fused_dma_overlap_ring_interpret OK (single+multi chunk, both BCs)")
+
+
 def check_sharded_checkpoint_roundtrip():
     import tempfile
 
@@ -577,6 +633,7 @@ def main():
     check_halo_ghost_identity()
     check_multistep_vs_golden()
     check_dma_halo_ring_interpret()
+    check_fused_dma_overlap_ring_interpret()
     check_sharded_checkpoint_roundtrip()
     check_gather_slice_distributed()
     print("ALL MULTIDEVICE CHECKS PASSED")

@@ -1,0 +1,149 @@
+"""Fused DMA-overlap kernel (ops/stencil_dma_fused): dispatch gates,
+TPU cross-lowering, and the out-of-scope error contract.
+
+Execution parity runs on the real 8-device CPU ring in
+tests/multidevice_checks.py (check_fused_dma_overlap_ring_interpret) —
+jax 0.9's interpret mode cannot discharge remote DMA on >1-named-axis
+meshes, so the production 3-axis-mesh dispatch is covered here by
+host-side Pallas->Mosaic lowering (the tier that catches block-spec and
+semaphore plumbing violations without hardware).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.ops.stencil_dma_fused import (
+    fused_dma_supported,
+    taps_faces_only,
+)
+from heat3d_tpu.parallel.step import _fused_dma_fn, make_step_fn
+from heat3d_tpu.parallel.topology import abstract_mesh, lower_for_mesh
+
+
+def _taps(kind, shape):
+    gc = GridConfig(shape=shape)
+    return stencil_taps(STENCILS[kind], gc.alpha, gc.effective_dt(), gc.spacing)
+
+
+def test_taps_faces_only_gate(monkeypatch):
+    shape = (16, 16, 16)
+    assert taps_faces_only(_taps("7pt", shape))
+    # the factoring knob rewrites the chain but not the tap set
+    monkeypatch.setenv("HEAT3D_FACTOR_7PT", "1")
+    assert taps_faces_only(_taps("7pt", shape))
+    # a 27-point x-plane carries edge/corner taps — face transfers can't
+    # feed it
+    assert not taps_faces_only(_taps("27pt", shape))
+
+
+def test_fused_dma_supported_scope():
+    t7 = _taps("7pt", (32, 32, 32))
+    assert fused_dma_supported((4, 32, 32), (8, 1, 1), t7)
+    assert not fused_dma_supported((4, 32, 32), (1, 1, 1), t7)  # no ring
+    assert not fused_dma_supported((4, 32, 32), (2, 2, 2), t7)  # 3D block
+    assert not fused_dma_supported((4, 32, 32), (1, 8, 1), t7)  # y slab
+    assert not fused_dma_supported((1, 32, 32), (8, 1, 1), t7)  # nx < 2
+    assert not fused_dma_supported(
+        (4, 32, 32), (8, 1, 1), _taps("27pt", (32, 32, 32))
+    )
+
+
+def test_fused_dma_dispatch_gate(monkeypatch):
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(8, 1, 1)),
+        backend="auto",
+        halo="dma",
+        overlap=True,
+    )
+    assert _fused_dma_fn(cfg) is not None
+    # scope exits: 3D mesh, 27pt, ppermute transport, no overlap
+    for kw in (
+        dict(mesh=MeshConfig(shape=(2, 2, 2))),
+        dict(stencil=StencilConfig(kind="27pt")),
+        dict(halo="ppermute"),
+        dict(overlap=False),
+    ):
+        import dataclasses
+
+        assert _fused_dma_fn(dataclasses.replace(cfg, **kw)) is None
+
+
+@pytest.mark.parametrize(
+    "bc,bcv",
+    [(BoundaryCondition.DIRICHLET, 1.5), (BoundaryCondition.PERIODIC, 0.0)],
+)
+def test_fused_dma_step_lowers_for_multichip_tpu(bc, bcv, monkeypatch):
+    """The full make_step_fn dispatch — fused DMA-overlap kernel on the
+    production 3-axis (8,1,1) mesh — lowers to Mosaic with the residual
+    psum composed around it."""
+    monkeypatch.setenv("HEAT3D_DIRECT_FORCE", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        stencil=StencilConfig(kind="7pt", bc=bc, bc_value=bcv),
+        mesh=MeshConfig(shape=(8, 1, 1)),
+        backend="auto",
+        halo="dma",
+        overlap=True,
+    )
+    assert _fused_dma_fn(cfg) is not None
+    am = abstract_mesh(cfg.mesh)
+    step = make_step_fn(cfg, am, with_residual=True)
+    txt = lower_for_mesh(
+        step, cfg.mesh, (cfg.grid.shape, jnp.float32, P("x", "y", "z"))
+    ).as_text()
+    assert "tpu_custom_call" in txt  # the Mosaic fused kernel
+    assert "all-reduce" in txt or "all_reduce" in txt  # residual psum
+
+
+def test_fused_dma_multichunk_lowers_for_tpu(monkeypatch):
+    """Chunked-column mode (by < ny): the 8-row-aligned ghost-row blocks
+    and the dynamic ghost-plane row slices lower for the TPU target."""
+    import heat3d_tpu.ops.stencil_dma_fused as fused_mod
+
+    monkeypatch.setenv("HEAT3D_DIRECT_FORCE", "1")
+    monkeypatch.setattr(fused_mod, "choose_chunk", lambda *a, **k: 8)
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(8, 1, 1)),
+        backend="auto",
+        halo="dma",
+        overlap=True,
+    )
+    am = abstract_mesh(cfg.mesh)
+    step = make_step_fn(cfg, am)
+    txt = lower_for_mesh(
+        step, cfg.mesh, (cfg.grid.shape, jnp.float32, P("x", "y", "z"))
+    ).as_text()
+    assert "tpu_custom_call" in txt
+
+
+def test_overlap_dma_out_of_scope_still_errors():
+    """Outside the fused kernel's scope, overlap+dma keeps the clear
+    config error (the DMA exchange kernels cannot overlap with compute)."""
+    cfg = SolverConfig(
+        grid=GridConfig.cube(16),
+        stencil=StencilConfig(kind="27pt"),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        backend="jnp",
+        halo="dma",
+        overlap=True,
+    )
+    am = abstract_mesh(cfg.mesh)
+    with pytest.raises(ValueError, match="fused DMA-overlap"):
+        make_step_fn(cfg, am)
