@@ -38,7 +38,7 @@ inside shard_map.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,8 +50,6 @@ from jax.experimental.pallas import tpu as pltpu
 from heat3d_tpu.core.stencils import effective_num_taps, flat_taps
 from heat3d_tpu.ops.stencil_pallas import _plane_taps
 from heat3d_tpu.ops.stencil_pallas_direct import (
-    _LANE,
-    _SUBLANE,
     _chunk_ghost_rows,
     _plane_bytes,
     _row_block_specs,

@@ -171,6 +171,25 @@ def _local_step(
     return _pin_padding(u_new, cfg)
 
 
+def _kernel_env_gate(cfg: SolverConfig):
+    """Shared dispatch gate for the Mosaic kernel routes: returns
+    ``(ok, interpret)`` — ok=False when the config/env rules out any real
+    kernel (backend, padding, platform), interpret=True when
+    HEAT3D_DIRECT_INTERPRET routes the kernel through the Pallas
+    interpreter off-TPU (tests)."""
+    import os
+
+    if cfg.backend not in ("pallas", "auto"):
+        return False, False
+    if cfg.is_padded:
+        return False, False
+    interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
+    forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))
+    if not interpret and not forced and jax.devices()[0].platform != "tpu":
+        return False, False
+    return True, interpret
+
+
 def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
     """Return the BC-fused direct Pallas kernel for this config, or None.
 
@@ -198,16 +217,11 @@ def _direct_kernel_fn(cfg: SolverConfig, halo: int, multichip: bool = False):
     # only the tb=2 superstep keeps its overlap mutual exclusion
     if cfg.overlap and halo != 1:
         return None
-    if cfg.backend not in ("pallas", "auto"):
-        return None
-    if cfg.is_padded:
-        return None
     # HEAT3D_DIRECT_INTERPRET exercises this dispatch path off-TPU (tests);
     # HEAT3D_DIRECT_FORCE selects the real (Mosaic) kernels off-TPU for
     # compile-only cross-lowering tests
-    interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
-    forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))
-    if not interpret and not forced and jax.devices()[0].platform != "tpu":
+    ok, interpret = _kernel_env_gate(cfg)
+    if not ok:
         return None
     try:
         from heat3d_tpu.ops.stencil_pallas_direct import (
@@ -425,18 +439,14 @@ def _fused_dma_fn(cfg: SolverConfig):
     every x-interior output plane while they fly, and waits only for the
     two shard-boundary planes. Scope gates mirror the kernel's
     (ops/stencil_dma_fused.fused_dma_supported): 7-point-family taps, 1D
-    x-slab mesh, unpadded shards."""
-    import os
-
+    x-slab mesh, unpadded shards. HEAT3D_NO_DIRECT does NOT disable this
+    route (deliberate asymmetry: that knob A/Bs the direct kernels against
+    the exchange path; this route is selected explicitly by
+    overlap+halo='dma')."""
     if not (cfg.overlap and cfg.halo == "dma"):
         return None
-    if cfg.backend not in ("pallas", "auto"):
-        return None
-    if cfg.is_padded:
-        return None
-    interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
-    forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))
-    if not interpret and not forced and jax.devices()[0].platform != "tpu":
+    ok, interpret = _kernel_env_gate(cfg)
+    if not ok:
         return None
     try:
         from heat3d_tpu.ops.stencil_dma_fused import (

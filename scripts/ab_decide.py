@@ -32,7 +32,16 @@ import sys
 
 SESSION_HEADER = "=== tpu_measure_all"
 _LINE = re.compile(r"^([A-Za-z0-9_=/. -]+?):\s*(\{.*\})\s*$")
-METRIC = "gcell_per_sec_per_chip"
+# bench-harness rows vs CLI summary lines (stage 3g logs the latter) name
+# the throughput metric differently; first present key wins
+METRIC_KEYS = ("gcell_per_sec_per_chip", "gcell_updates_per_sec_per_chip")
+
+
+def _metric(row: dict):
+    for k in METRIC_KEYS:
+        if k in row:
+            return float(row[k])
+    return None
 
 
 def parse_knobs(prefix: str) -> dict:
@@ -60,7 +69,7 @@ def parse_lines(text: str, all_sessions: bool = False):
             row = json.loads(m.group(2))
         except json.JSONDecodeError:
             continue
-        if not (isinstance(row, dict) and METRIC in row):
+        if not (isinstance(row, dict) and _metric(row) is not None):
             continue
         yield parse_knobs(m.group(1)), row
 
@@ -87,7 +96,7 @@ def decide(entries, min_win_pct: float = 5.0):
     """Return decision dicts for every single-knob A/B pair found."""
     out = []
     for knob, fixed, (va, ra), (vb, rb) in pair_rows(entries):
-        ga, gb = float(ra[METRIC]), float(rb[METRIC])
+        ga, gb = _metric(ra), _metric(rb)
         if ga <= 0 or gb <= 0:
             continue
         winner = vb if gb >= ga else va

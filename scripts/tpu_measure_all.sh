@@ -75,10 +75,18 @@ probe_kernel() {  # probe_kernel NAME CMD... -> 0 ok/inconclusive, 1 kernel fail
     return 0
   fi
   if [[ $rc -eq 124 || $rc -eq 137 ]]; then
-    # timeout/SIGKILL = the tunnel died under the probe, not a kernel
-    # verdict — inconclusive, route stays enabled (its A/B iterations
-    # re-gate on wait_tpu anyway)
-    echo "probe $name: timed out (rc=$rc) — inconclusive, route stays enabled" \
+    # Timeout: either the tunnel died under the probe (inconclusive) or
+    # the kernel itself deadlocked (a real verdict — letting it through
+    # would hang every suite row that uses it). A quick re-probe of the
+    # backend distinguishes them: still reachable means the hang was the
+    # kernel's.
+    if python -m heat3d_tpu.utils.backendprobe --wait 120 --interval 20 \
+        >/dev/null 2>&1; then
+      echo "probe $name: HUNG (rc=$rc) with the tunnel healthy — kernel deadlock, route disabled" \
+        | tee -a "$LOG"
+      return 1
+    fi
+    echo "probe $name: timed out (rc=$rc) with the tunnel down — inconclusive, route stays enabled" \
       | tee -a "$LOG"
     return 0
   fi
