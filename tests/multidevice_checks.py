@@ -574,9 +574,51 @@ def check_fused_dma_overlap_ring_interpret():
                     np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
                     err_msg=f"by={by} bc={bc} bcv={bcv}",
                 )
+        # bf16 storage / fp32 compute (the judged config-5 flavor): fused
+        # result tracks the bf16 jnp single-device step across the same
+        # {chunk mode} x {BC} matrix as the fp32 tier (2-byte itemsize
+        # exercises the ghost-row loads and ring tiles at bf16 geometry)
+        ub = jnp.asarray(u_host).astype(jnp.bfloat16)
+        u16 = jax.device_put(ub, NamedSharding(mesh, spec))
+        for by in (None, 8):
+            fused_mod.choose_chunk = (
+                orig_chunk if by is None else lambda *a, _by=by, **k: _by
+            )
+            for bc, bcv in [
+                (BoundaryCondition.DIRICHLET, 1.5),
+                (BoundaryCondition.PERIODIC, 0.0),
+            ]:
+                got16 = jax.jit(
+                    jax.shard_map(
+                        lambda x, p=bc is BoundaryCondition.PERIODIC, v=bcv:
+                        fused_mod.apply_step_fused_dma(
+                            x, taps, axis_name="x", axis_size=8,
+                            mesh_axes=("x",), periodic=p, bc_value=v,
+                            interpret=True,
+                        ),
+                        mesh=mesh, in_specs=spec, out_specs=spec,
+                        check_vma=False,
+                    )
+                )(u16)
+                want16 = step_single_device(
+                    ub, taps, bc, bcv, precision=Precision.bf16()
+                )
+                assert got16.dtype == jnp.bfloat16
+                assert want16.dtype == jnp.bfloat16
+                # kernel vs jnp accumulate in different association orders
+                # (fp32) before the one bf16 round-off: 1 bf16 ulp (2^-8)
+                np.testing.assert_allclose(
+                    np.asarray(got16.astype(jnp.float32)),
+                    np.asarray(want16.astype(jnp.float32)),
+                    rtol=4e-3, atol=4e-3,
+                    err_msg=f"bf16 fused-dma by={by} bc={bc}",
+                )
     finally:
         fused_mod.choose_chunk = orig_chunk
-    print("fused_dma_overlap_ring_interpret OK (single+multi chunk, both BCs)")
+    print(
+        "fused_dma_overlap_ring_interpret OK "
+        "(single+multi chunk, both BCs, bf16)"
+    )
 
 
 def check_sharded_checkpoint_roundtrip():
