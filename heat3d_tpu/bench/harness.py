@@ -116,7 +116,19 @@ def bench_throughput(
         # selection (the real selector, not the env) so the traffic model
         # can't mislabel them.
         "direct_path": direct,
+        # overlap+halo='dma' rows: whether the fused DMA-overlap kernel
+        # (vs an error'd/jnp fallback elsewhere) actually resolved —
+        # the pod A/B vs faces-direct needs the RESOLVED route on record
+        "fused_dma_path": _resolved_fused_dma(cfg),
     }
+
+
+def _resolved_fused_dma(cfg: SolverConfig) -> bool:
+    """Whether this config's step resolves to the fused DMA-overlap kernel
+    (parallel.step._fused_dma_fn — overlap+halo='dma', 7pt x-slab scope)."""
+    from heat3d_tpu.parallel.step import _fused_dma_fn
+
+    return _fused_dma_fn(cfg) is not None
 
 
 def _resolved_direct(cfg: SolverConfig) -> bool:

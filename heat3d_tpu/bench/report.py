@@ -65,7 +65,13 @@ def _fmt_route(r: Dict) -> str:
         return "conv"  # one XLA conv op — neither transport tier applies
     if "direct_path" not in r and "chain_ops" not in r:
         return "—"
-    parts = ["direct" if r.get("direct_path") else "exch"]
+    if r.get("fused_dma_path"):
+        transport = "fused-dma"  # RDMA issued inside the sweep kernel
+    elif r.get("direct_path"):
+        transport = "direct"
+    else:
+        transport = "exch"
+    parts = [transport]
     route = "mehr" if r.get("mehrstellen_route") else "chain"
     ops = r.get("chain_ops")
     parts.append(f"{route}({ops})" if ops is not None else route)

@@ -45,6 +45,10 @@ def bytes_per_cell_update(row) -> tuple[float, str]:
     # read+write per exchange). Prefer the RESOLVED selection the harness
     # recorded (exact even for HEAT3D_NO_DIRECT A/B rows); derive for
     # legacy rows.
+    if row.get("fused_dma_path"):
+        # fused DMA-overlap kernel: unpadded streaming sweep (tb=1 only),
+        # same traffic shape as the direct kernels
+        return 2 * item, "fused-dma"
     direct = row.get("direct_path")
     if direct is None:
         direct = halo == "ppermute" and tb in (1, 2)

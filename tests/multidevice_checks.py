@@ -545,79 +545,56 @@ def check_fused_dma_overlap_ring_interpret():
     u_host = golden.random_init(grid, seed=31)
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
     spec = P("x")
-    u = jax.device_put(jnp.asarray(u_host), NamedSharding(mesh, spec))
     orig_chunk = fused_mod.choose_chunk
+    # One matrix over {precision tier} x {chunk mode} x {BC}: fp32 matches
+    # to FMA rounding; bf16 storage / fp32 compute (the judged config-5
+    # flavor, 2-byte itemsize exercising the ghost-row loads and ring
+    # tiles at bf16 geometry) matches to 1 bf16 ulp (2^-8) — kernel vs
+    # jnp accumulate in different association orders before the one
+    # storage-dtype round-off.
+    tiers = [
+        (jnp.asarray(u_host), Precision(), 1e-6),
+        (jnp.asarray(u_host).astype(jnp.bfloat16), Precision.bf16(), 4e-3),
+    ]
     try:
-        for by in (None, 8):  # None = real chooser (single chunk), 8 = 2 chunks
-            if by is not None:
-                fused_mod.choose_chunk = lambda *a, _by=by, **k: _by
-            else:
-                fused_mod.choose_chunk = orig_chunk
-            for bc, bcv in [
-                (BoundaryCondition.DIRICHLET, 1.5),
-                (BoundaryCondition.PERIODIC, 0.0),
-            ]:
-                periodic = bc is BoundaryCondition.PERIODIC
-                got = jax.jit(
-                    jax.shard_map(
-                        lambda x, p=periodic, v=bcv: fused_mod.apply_step_fused_dma(
-                            x, taps, axis_name="x", axis_size=8,
-                            mesh_axes=("x",), periodic=p, bc_value=v,
-                            interpret=True,
-                        ),
-                        mesh=mesh, in_specs=spec, out_specs=spec,
-                        check_vma=False,
+        for u_in, prec, tol in tiers:
+            u_dev = jax.device_put(u_in, NamedSharding(mesh, spec))
+            for by in (None, 8):  # None = real chooser (single chunk); 8 = 2 chunks
+                fused_mod.choose_chunk = (
+                    orig_chunk if by is None else lambda *a, _by=by, **k: _by
+                )
+                for bc, bcv in [
+                    (BoundaryCondition.DIRICHLET, 1.5),
+                    (BoundaryCondition.PERIODIC, 0.0),
+                ]:
+                    got = jax.jit(
+                        jax.shard_map(
+                            lambda x, p=bc is BoundaryCondition.PERIODIC,
+                            v=bcv: fused_mod.apply_step_fused_dma(
+                                x, taps, axis_name="x", axis_size=8,
+                                mesh_axes=("x",), periodic=p, bc_value=v,
+                                interpret=True,
+                            ),
+                            mesh=mesh, in_specs=spec, out_specs=spec,
+                            check_vma=False,
+                        )
+                    )(u_dev)
+                    want = step_single_device(
+                        u_in, taps, bc, bcv, precision=prec
                     )
-                )(u)
-                want = step_single_device(jnp.asarray(u_host), taps, bc, bcv)
-                np.testing.assert_allclose(
-                    np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6,
-                    err_msg=f"by={by} bc={bc} bcv={bcv}",
-                )
-        # bf16 storage / fp32 compute (the judged config-5 flavor): fused
-        # result tracks the bf16 jnp single-device step across the same
-        # {chunk mode} x {BC} matrix as the fp32 tier (2-byte itemsize
-        # exercises the ghost-row loads and ring tiles at bf16 geometry)
-        ub = jnp.asarray(u_host).astype(jnp.bfloat16)
-        u16 = jax.device_put(ub, NamedSharding(mesh, spec))
-        for by in (None, 8):
-            fused_mod.choose_chunk = (
-                orig_chunk if by is None else lambda *a, _by=by, **k: _by
-            )
-            for bc, bcv in [
-                (BoundaryCondition.DIRICHLET, 1.5),
-                (BoundaryCondition.PERIODIC, 0.0),
-            ]:
-                got16 = jax.jit(
-                    jax.shard_map(
-                        lambda x, p=bc is BoundaryCondition.PERIODIC, v=bcv:
-                        fused_mod.apply_step_fused_dma(
-                            x, taps, axis_name="x", axis_size=8,
-                            mesh_axes=("x",), periodic=p, bc_value=v,
-                            interpret=True,
-                        ),
-                        mesh=mesh, in_specs=spec, out_specs=spec,
-                        check_vma=False,
+                    assert got.dtype == jnp.dtype(prec.storage)
+                    assert want.dtype == jnp.dtype(prec.storage)
+                    np.testing.assert_allclose(
+                        np.asarray(got.astype(jnp.float32)),
+                        np.asarray(want.astype(jnp.float32)),
+                        rtol=tol, atol=tol,
+                        err_msg=f"dtype={prec.storage} by={by} bc={bc}",
                     )
-                )(u16)
-                want16 = step_single_device(
-                    ub, taps, bc, bcv, precision=Precision.bf16()
-                )
-                assert got16.dtype == jnp.bfloat16
-                assert want16.dtype == jnp.bfloat16
-                # kernel vs jnp accumulate in different association orders
-                # (fp32) before the one bf16 round-off: 1 bf16 ulp (2^-8)
-                np.testing.assert_allclose(
-                    np.asarray(got16.astype(jnp.float32)),
-                    np.asarray(want16.astype(jnp.float32)),
-                    rtol=4e-3, atol=4e-3,
-                    err_msg=f"bf16 fused-dma by={by} bc={bc}",
-                )
     finally:
         fused_mod.choose_chunk = orig_chunk
     print(
         "fused_dma_overlap_ring_interpret OK "
-        "(single+multi chunk, both BCs, bf16)"
+        "(fp32+bf16, single+multi chunk, both BCs)"
     )
 
 

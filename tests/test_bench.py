@@ -327,6 +327,30 @@ def test_throughput_row_records_resolved_direct_path(monkeypatch):
     assert _resolved_direct(cfg) is False
 
 
+def test_throughput_row_records_resolved_fused_dma_path(monkeypatch):
+    """fused_dma_path records the REAL fused-route selector's decision:
+    True for an in-scope overlap+halo='dma' x-slab config (interpret mode
+    stands in for TPU off-chip), False for ppermute transport or a 3D
+    mesh — so pod A/B rows vs faces-direct stay tellable apart."""
+    import dataclasses
+
+    from heat3d_tpu.bench.harness import _resolved_fused_dma
+    from heat3d_tpu.core.config import GridConfig, MeshConfig, SolverConfig
+
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        mesh=MeshConfig(shape=(8, 1, 1)),
+        halo="dma",
+        overlap=True,
+    )
+    assert _resolved_fused_dma(cfg) is True
+    assert _resolved_fused_dma(dataclasses.replace(cfg, halo="ppermute")) is False
+    assert _resolved_fused_dma(
+        dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 2, 2)))
+    ) is False
+
+
 def test_chain_ops_tracks_mehrstellen_route(monkeypatch):
     """chain_ops provenance must record what EXECUTES: the separable
     route's canonical 14-op count when the mehrstellen knob engages the
