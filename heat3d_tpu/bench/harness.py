@@ -125,7 +125,7 @@ def bench_throughput(
 
 def _resolved_fused_dma(cfg: SolverConfig) -> bool:
     """Whether this config's step resolves to the fused DMA-overlap kernel
-    (parallel.step._fused_dma_fn — overlap+halo='dma', 7pt x-slab scope)."""
+    (parallel.step._fused_dma_fn — overlap+halo='dma', x-slab scope)."""
     from heat3d_tpu.parallel.step import _fused_dma_fn
 
     return _fused_dma_fn(cfg) is not None

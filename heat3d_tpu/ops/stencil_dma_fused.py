@@ -7,7 +7,8 @@ stream while the halo faces exchange on another, then update the boundary.
 The ppermute transports get this overlap from XLA's async collectives (the
 faces-direct step); the RDMA transport (ops/halo_pallas) could not — its
 exchange kernel starts AND waits its DMAs before any compute runs. This
-kernel closes that gap for the slab-decomposed 7-point configs: the two
+kernel closes that gap for the slab-decomposed configs (both stencil
+families): the two
 x-face remote copies are issued at grid step 0, the streaming sweep then
 emits every x-interior output plane (1 .. nx-2) — which depend only on
 local planes — while the faces are in flight over ICI, and only the last
@@ -26,13 +27,14 @@ at step nx+1, making output 0's emit at step nx+3 the same slot pattern
 emit path, outputs ordered interior-first — overlap falls out of the index
 maps instead of a second kernel.
 
-Scope (the dispatch gate `fused_dma_supported` enforces this): taps whose
-x-neighbor planes touch only the center cell (the 7-point family — a
-27-point x-plane needs edge/corner ghosts, which face-only transfers do
-not carry), a mesh sharded along axis 0 only (the judged 1D slab
-decomposition; y/z stay domain boundaries synthesized in-register exactly
-as ops/stencil_pallas_direct does), unpadded shards, nx >= 2. Must run
-inside shard_map.
+Scope (the dispatch gate `fused_dma_supported` enforces this): a mesh
+sharded along axis 0 only (the judged 1D slab decomposition; y/z stay
+domain boundaries synthesized in-register exactly as
+ops/stencil_pallas_direct does), unpadded shards, nx >= 2. BOTH judged
+stencil families qualify: an x-slab mesh has no corner neighbors — the
+received x-ghost plane is the complete neighbor data, and its y/z frame
+(which the 27-point x-plane taps read) is a domain boundary synthesized
+from the resident plane. Must run inside shard_map.
 """
 
 from __future__ import annotations
@@ -67,17 +69,6 @@ _GHOST_BUDGET = 16 * 1024 * 1024
 _COLLECTIVE_ID = 3
 
 
-def taps_faces_only(taps: np.ndarray) -> bool:
-    """True when every x-neighbor tap touches only the center of its plane
-    (di != 0 implies dj == dk == 0) — the structural property that lets
-    face-only ghost transfers feed a correct boundary-plane update."""
-    return all(
-        (dj, dk) == (0, 0)
-        for di, dj, dk, _ in flat_taps(taps)
-        if di != 0
-    )
-
-
 def fused_dma_supported(
     local_shape: Tuple[int, int, int],
     mesh_shape: Tuple[int, int, int],
@@ -86,13 +77,15 @@ def fused_dma_supported(
     out_itemsize: int = 4,
     compute_itemsize: int = 4,
 ) -> bool:
+    """Any 3x3x3 tap set qualifies: on a 1D x-slab mesh the received
+    x-ghost plane IS the complete neighbor data (no corner neighbors
+    exist — y/z are domain boundaries whose frame is synthesized
+    in-register), so the 27-point family rides the same kernel."""
     nx, ny, nz = local_shape
     if nx < 2:
         return False  # the re-loaded planes 0/1 must be distinct x-planes
     if mesh_shape[0] < 2 or mesh_shape[1] != 1 or mesh_shape[2] != 1:
-        return False  # v1 scope: 1D slab decomposition along x
-    if not taps_faces_only(taps):
-        return False
+        return False  # scope: 1D slab decomposition along x
     if 2 * _plane_bytes(ny, nz, in_itemsize) > _GHOST_BUDGET:
         return False
     return (
@@ -210,9 +203,35 @@ def _fused_kernel(
         jnp.logical_not(periodic), my == axis_size - 1
     )
 
+    ny = by * n_chunks
+
     def ghost_chunk(ref, edge):
         g = ref[pl.ds(j * by, by), :]
         return jnp.where(edge, jnp.full_like(g, bc), g)
+
+    def ghost_plane_rows(ref, edge):
+        """The (1, nz) y-ghost rows above/below chunk j of a received
+        ghost plane. The full (ny, nz) plane is resident, so neighbor
+        rows are direct reads; at the y DOMAIN boundary the row wraps
+        (periodic — y is unsharded, so the wrap is genuine data) or is
+        the boundary value. A Dirichlet-edge device's whole ghost plane
+        is bc, rows included."""
+        if periodic:
+            ti = lax.rem(j * by - 1 + ny, ny)
+            bi = lax.rem(j * by + by, ny)
+            return ref[pl.ds(ti, 1), :], ref[pl.ds(bi, 1), :]
+        fill = jnp.full((1, nz), bc, u_win.dtype)
+        ti = jnp.maximum(j * by - 1, 0)
+        bi = jnp.minimum(j * by + by, ny - 1)
+        topg = jnp.where(
+            jnp.logical_or(j == 0, edge), fill, ref[pl.ds(ti, 1), :]
+        )
+        botg = jnp.where(
+            jnp.logical_or(j == n_chunks - 1, edge),
+            fill,
+            ref[pl.ds(bi, 1), :],
+        )
+        return topg, botg
 
     real_plane = i <= nx - 1
     for k in range(3):
@@ -224,25 +243,27 @@ def _fused_kernel(
     # Step nx: the HIGH ghost enters the ring as "plane nx"; step nx+1 the
     # LOW ghost as the future "plane -1"; steps nx+2 / nx+3 re-load planes
     # 0 / 1 (the window fetches them via the index map — `chunk` already
-    # holds the right data). Ghost planes only ever sit in a +-1 emit slot
-    # and faces-only taps read just their (by, nz) interior, so their
-    # frames are never consumed; the bc frame is arbitrary.
+    # holds the right data). Ghost planes are framed like every other
+    # plane — their y/z frame is a DOMAIN boundary on an x-slab mesh, so
+    # wrap/bc synthesis from the resident full plane is exact, which is
+    # what lets the 27-point family (whose x-planes read their frames)
+    # ride this kernel.
     for k in range(3):
 
         @pl.when(jnp.logical_and(i == nx, lax.rem(i, 3) == k))
         def _store_hi(k=k):
+            gt, gb = ghost_plane_rows(ghi_ref, is_hi_edge)
             _store_framed_plane(
-                ring, k, ghost_chunk(ghi_ref, is_hi_edge),
-                jnp.full_like(top, bc), jnp.full_like(bot, bc),
-                bc, False, 1,
+                ring, k, ghost_chunk(ghi_ref, is_hi_edge), gt, gb,
+                bc, periodic, 1,
             )
 
         @pl.when(jnp.logical_and(i == nx + 1, lax.rem(i, 3) == k))
         def _store_lo(k=k):
+            gt, gb = ghost_plane_rows(glo_ref, is_lo_edge)
             _store_framed_plane(
-                ring, k, ghost_chunk(glo_ref, is_lo_edge),
-                jnp.full_like(top, bc), jnp.full_like(bot, bc),
-                bc, False, 1,
+                ring, k, ghost_chunk(glo_ref, is_lo_edge), gt, gb,
+                bc, periodic, 1,
             )
 
         @pl.when(jnp.logical_and(i >= nx + 2, lax.rem(i, 3) == k))

@@ -438,8 +438,8 @@ def _fused_dma_fn(cfg: SolverConfig):
     §7.1 item 7): one Pallas kernel issues the x-face remote copies, sweeps
     every x-interior output plane while they fly, and waits only for the
     two shard-boundary planes. Scope gates mirror the kernel's
-    (ops/stencil_dma_fused.fused_dma_supported): 7-point-family taps, 1D
-    x-slab mesh, unpadded shards. HEAT3D_NO_DIRECT does NOT disable this
+    (ops/stencil_dma_fused.fused_dma_supported): 1D x-slab mesh, unpadded
+    shards, either stencil family. HEAT3D_NO_DIRECT does NOT disable this
     route (deliberate asymmetry: that knob A/Bs the direct kernels against
     the exchange path; this route is selected explicitly by
     overlap+halo='dma')."""
@@ -609,7 +609,7 @@ def make_step_fn(
             if cfg.halo == "dma":
                 raise ValueError(
                     "overlap=True with halo='dma' needs the fused "
-                    "DMA-overlap kernel (7-point-family stencil, 1D x-slab "
+                    "DMA-overlap kernel (1D x-slab "
                     "mesh with >= 2 devices, unpadded shards, TPU); outside "
                     "that scope the side-effecting DMA exchange kernels "
                     "cannot overlap with compute — use halo='ppermute' for "

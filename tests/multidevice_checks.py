@@ -539,62 +539,70 @@ def check_fused_dma_overlap_ring_interpret():
 
     grid = (16, 16, 16)
     gc = GridConfig(shape=grid)
-    taps = stencil_taps(
-        STENCILS["7pt"], gc.alpha, gc.effective_dt(), gc.spacing
-    )
     u_host = golden.random_init(grid, seed=31)
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
     spec = P("x")
     orig_chunk = fused_mod.choose_chunk
-    # One matrix over {precision tier} x {chunk mode} x {BC}: fp32 matches
-    # to FMA rounding; bf16 storage / fp32 compute (the judged config-5
-    # flavor, 2-byte itemsize exercising the ghost-row loads and ring
-    # tiles at bf16 geometry) matches to 1 bf16 ulp (2^-8) — kernel vs
-    # jnp accumulate in different association orders before the one
-    # storage-dtype round-off.
+    # One matrix over {stencil} x {precision tier} x {chunk mode} x {BC}:
+    # 27pt exercises the ghost-plane FRAMES (its x-plane taps read them —
+    # the x-slab-has-no-corner-neighbors property the widened gate rests
+    # on); fp32 matches to FMA rounding; bf16 storage / fp32 compute (the
+    # judged config-5 flavor, 2-byte itemsize exercising the ghost-row
+    # loads and ring tiles at bf16 geometry) matches to 1 bf16 ulp (2^-8)
+    # — kernel vs jnp accumulate in different association orders before
+    # the one storage-dtype round-off.
     tiers = [
         (jnp.asarray(u_host), Precision(), 1e-6),
         (jnp.asarray(u_host).astype(jnp.bfloat16), Precision.bf16(), 4e-3),
     ]
     try:
-        for u_in, prec, tol in tiers:
-            u_dev = jax.device_put(u_in, NamedSharding(mesh, spec))
-            for by in (None, 8):  # None = real chooser (single chunk); 8 = 2 chunks
-                fused_mod.choose_chunk = (
-                    orig_chunk if by is None else lambda *a, _by=by, **k: _by
-                )
-                for bc, bcv in [
-                    (BoundaryCondition.DIRICHLET, 1.5),
-                    (BoundaryCondition.PERIODIC, 0.0),
-                ]:
-                    got = jax.jit(
-                        jax.shard_map(
-                            lambda x, p=bc is BoundaryCondition.PERIODIC,
-                            v=bcv: fused_mod.apply_step_fused_dma(
-                                x, taps, axis_name="x", axis_size=8,
-                                mesh_axes=("x",), periodic=p, bc_value=v,
-                                interpret=True,
-                            ),
-                            mesh=mesh, in_specs=spec, out_specs=spec,
-                            check_vma=False,
+        for kind in ("7pt", "27pt"):
+            taps = stencil_taps(
+                STENCILS[kind], gc.alpha, gc.effective_dt(), gc.spacing
+            )
+            for u_in, prec, tol in tiers:
+                u_dev = jax.device_put(u_in, NamedSharding(mesh, spec))
+                for by in (None, 8):  # None = real chooser; 8 = 2 chunks
+                    fused_mod.choose_chunk = (
+                        orig_chunk if by is None
+                        else lambda *a, _by=by, **k: _by
+                    )
+                    for bc, bcv in [
+                        (BoundaryCondition.DIRICHLET, 1.5),
+                        (BoundaryCondition.PERIODIC, 0.0),
+                    ]:
+                        got = jax.jit(
+                            jax.shard_map(
+                                lambda x, t=taps,
+                                p=bc is BoundaryCondition.PERIODIC,
+                                v=bcv: fused_mod.apply_step_fused_dma(
+                                    x, t, axis_name="x", axis_size=8,
+                                    mesh_axes=("x",), periodic=p,
+                                    bc_value=v, interpret=True,
+                                ),
+                                mesh=mesh, in_specs=spec, out_specs=spec,
+                                check_vma=False,
+                            )
+                        )(u_dev)
+                        want = step_single_device(
+                            u_in, taps, bc, bcv, precision=prec
                         )
-                    )(u_dev)
-                    want = step_single_device(
-                        u_in, taps, bc, bcv, precision=prec
-                    )
-                    assert got.dtype == jnp.dtype(prec.storage)
-                    assert want.dtype == jnp.dtype(prec.storage)
-                    np.testing.assert_allclose(
-                        np.asarray(got.astype(jnp.float32)),
-                        np.asarray(want.astype(jnp.float32)),
-                        rtol=tol, atol=tol,
-                        err_msg=f"dtype={prec.storage} by={by} bc={bc}",
-                    )
+                        assert got.dtype == jnp.dtype(prec.storage)
+                        assert want.dtype == jnp.dtype(prec.storage)
+                        np.testing.assert_allclose(
+                            np.asarray(got.astype(jnp.float32)),
+                            np.asarray(want.astype(jnp.float32)),
+                            rtol=tol, atol=tol,
+                            err_msg=(
+                                f"{kind} dtype={prec.storage} by={by} "
+                                f"bc={bc}"
+                            ),
+                        )
     finally:
         fused_mod.choose_chunk = orig_chunk
     print(
         "fused_dma_overlap_ring_interpret OK "
-        "(fp32+bf16, single+multi chunk, both BCs)"
+        "(7pt+27pt, fp32+bf16, single+multi chunk, both BCs)"
     )
 
 

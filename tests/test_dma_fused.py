@@ -24,10 +24,7 @@ from heat3d_tpu.core.config import (
     StencilConfig,
 )
 from heat3d_tpu.core.stencils import STENCILS, stencil_taps
-from heat3d_tpu.ops.stencil_dma_fused import (
-    fused_dma_supported,
-    taps_faces_only,
-)
+from heat3d_tpu.ops.stencil_dma_fused import fused_dma_supported
 from heat3d_tpu.parallel.step import _fused_dma_fn, make_step_fn
 from heat3d_tpu.parallel.topology import abstract_mesh, lower_for_mesh
 
@@ -37,17 +34,6 @@ def _taps(kind, shape):
     return stencil_taps(STENCILS[kind], gc.alpha, gc.effective_dt(), gc.spacing)
 
 
-def test_taps_faces_only_gate(monkeypatch):
-    shape = (16, 16, 16)
-    assert taps_faces_only(_taps("7pt", shape))
-    # the factoring knob rewrites the chain but not the tap set
-    monkeypatch.setenv("HEAT3D_FACTOR_7PT", "1")
-    assert taps_faces_only(_taps("7pt", shape))
-    # a 27-point x-plane carries edge/corner taps — face transfers can't
-    # feed it
-    assert not taps_faces_only(_taps("27pt", shape))
-
-
 def test_fused_dma_supported_scope():
     t7 = _taps("7pt", (32, 32, 32))
     assert fused_dma_supported((4, 32, 32), (8, 1, 1), t7)
@@ -55,7 +41,9 @@ def test_fused_dma_supported_scope():
     assert not fused_dma_supported((4, 32, 32), (2, 2, 2), t7)  # 3D block
     assert not fused_dma_supported((4, 32, 32), (1, 8, 1), t7)  # y slab
     assert not fused_dma_supported((1, 32, 32), (8, 1, 1), t7)  # nx < 2
-    assert not fused_dma_supported(
+    # 27pt qualifies: an x-slab has no corner neighbors, and the received
+    # ghost plane's y/z frame is a domain boundary synthesized in-register
+    assert fused_dma_supported(
         (4, 32, 32), (8, 1, 1), _taps("27pt", (32, 32, 32))
     )
 
@@ -71,30 +59,34 @@ def test_fused_dma_dispatch_gate(monkeypatch):
         overlap=True,
     )
     assert _fused_dma_fn(cfg) is not None
-    # scope exits: 3D mesh, 27pt, ppermute transport, no overlap
+    # 27pt also dispatches (x-slab scope covers both stencil families)
+    import dataclasses
+
+    assert _fused_dma_fn(
+        dataclasses.replace(cfg, stencil=StencilConfig(kind="27pt"))
+    ) is not None
+    # scope exits: 3D mesh, ppermute transport, no overlap
     for kw in (
         dict(mesh=MeshConfig(shape=(2, 2, 2))),
-        dict(stencil=StencilConfig(kind="27pt")),
         dict(halo="ppermute"),
         dict(overlap=False),
     ):
-        import dataclasses
-
         assert _fused_dma_fn(dataclasses.replace(cfg, **kw)) is None
 
 
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
 @pytest.mark.parametrize(
     "bc,bcv",
     [(BoundaryCondition.DIRICHLET, 1.5), (BoundaryCondition.PERIODIC, 0.0)],
 )
-def test_fused_dma_step_lowers_for_multichip_tpu(bc, bcv, monkeypatch):
+def test_fused_dma_step_lowers_for_multichip_tpu(kind, bc, bcv, monkeypatch):
     """The full make_step_fn dispatch — fused DMA-overlap kernel on the
     production 3-axis (8,1,1) mesh — lowers to Mosaic with the residual
     psum composed around it."""
     monkeypatch.setenv("HEAT3D_DIRECT_FORCE", "1")
     cfg = SolverConfig(
         grid=GridConfig.cube(32),
-        stencil=StencilConfig(kind="7pt", bc=bc, bc_value=bcv),
+        stencil=StencilConfig(kind=kind, bc=bc, bc_value=bcv),
         mesh=MeshConfig(shape=(8, 1, 1)),
         backend="auto",
         halo="dma",
