@@ -56,6 +56,7 @@ from heat3d_tpu.ops.stencil_pallas_direct import (
     _plane_bytes,
     _row_block_specs,
     _store_framed_plane,
+    _store_input_plane,
     choose_chunk,
 )
 
@@ -98,6 +99,76 @@ def fused_dma_supported(
     )
 
 
+def _rdma_halo(
+    u_any, glo_ref, ghi_ref, send_sem, recv_sem, *, nx, width,
+    axis_name, mesh_axes, axis_size, use_barrier,
+):
+    """The kernels' shared RDMA protocol, in ONE place (the semaphore/
+    barrier choreography is the trickiest invariant here): symmetric ring
+    pushes as in ops/halo_pallas._exchange_body — my high ``width``-slab
+    -> hi neighbor's low-ghost buffer (its completion on MY recv_sem[0]
+    is my LOW ghost arriving), and vice versa. Returns
+    ``(my, start, wait_hi_ghost, wait_lo_ghost)``; descriptors are rebuilt
+    at each use site — they are just op emitters over the same refs and
+    semaphores."""
+    my = lax.axis_index(axis_name)
+
+    def neighbor(delta):
+        idx = lax.rem(my + delta + axis_size, axis_size)
+        if len(mesh_axes) == 1:
+            return idx
+        return {axis_name: idx}
+
+    def src(lo):
+        if width == 1:  # integer-indexed 2D face matching the plane dst
+            return u_any.at[0 if lo else nx - 1]
+        return u_any.at[pl.ds(0 if lo else nx - width, width)]
+
+    def copy_to_hi_neighbor():
+        return pltpu.make_async_remote_copy(
+            src_ref=src(lo=False),
+            dst_ref=glo_ref,
+            send_sem=send_sem.at[0],
+            recv_sem=recv_sem.at[0],
+            device_id=neighbor(+1),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    def copy_to_lo_neighbor():
+        return pltpu.make_async_remote_copy(
+            src_ref=src(lo=True),
+            dst_ref=ghi_ref,
+            send_sem=send_sem.at[1],
+            recv_sem=recv_sem.at[1],
+            device_id=neighbor(-1),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+    def start():
+        if use_barrier:
+            # Neighbor barrier: nobody pushes into a peer's ghost buffers
+            # until that peer has entered this kernel (cross-call buffer
+            # reuse race guard). Skipped in interpret mode (synchronous
+            # emulation, no barrier-semaphore support).
+            barrier = pltpu.get_barrier_semaphore()
+            for delta in (-1, +1):
+                pltpu.semaphore_signal(
+                    barrier,
+                    inc=1,
+                    device_id=neighbor(delta),
+                    device_id_type=pltpu.DeviceIdType.MESH,
+                )
+            pltpu.semaphore_wait(barrier, 2)
+        copy_to_hi_neighbor().start()
+        copy_to_lo_neighbor().start()
+
+    # send_sem[1] + recv_sem[1]: my HIGH ghost has landed
+    wait_hi_ghost = lambda: copy_to_lo_neighbor().wait()  # noqa: E731
+    # send_sem[0] + recv_sem[0]: my LOW ghost has landed
+    wait_lo_ghost = lambda: copy_to_hi_neighbor().wait()  # noqa: E731
+    return my, start, wait_hi_ghost, wait_lo_ghost
+
+
 def _fused_kernel(
     u_win,
     u_any,
@@ -127,53 +198,15 @@ def _fused_kernel(
     j = pl.program_id(0)
     i = pl.program_id(1)
     bc = u_win.dtype.type(bc_value)
-    my = lax.axis_index(axis_name)
-
-    def neighbor(delta):
-        idx = lax.rem(my + delta + axis_size, axis_size)
-        if len(mesh_axes) == 1:
-            return idx
-        return {axis_name: idx}
-
-    # Same symmetric ring shape as ops/halo_pallas._exchange_body: my high
-    # face -> hi neighbor's low-ghost buffer (its completion on MY
-    # recv_sem[0] is my LOW ghost arriving), and vice versa. Descriptors
-    # are rebuilt at each use site — they are just op emitters over the
-    # same refs and semaphores.
-    def copy_to_hi_neighbor():
-        return pltpu.make_async_remote_copy(
-            src_ref=u_any.at[nx - 1],
-            dst_ref=glo_ref,
-            send_sem=send_sem.at[0],
-            recv_sem=recv_sem.at[0],
-            device_id=neighbor(+1),
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
-
-    def copy_to_lo_neighbor():
-        return pltpu.make_async_remote_copy(
-            src_ref=u_any.at[0],
-            dst_ref=ghi_ref,
-            send_sem=send_sem.at[1],
-            recv_sem=recv_sem.at[1],
-            device_id=neighbor(-1),
-            device_id_type=pltpu.DeviceIdType.MESH,
-        )
+    my, start_rdma, wait_hi_ghost, wait_lo_ghost = _rdma_halo(
+        u_any, glo_ref, ghi_ref, send_sem, recv_sem, nx=nx, width=1,
+        axis_name=axis_name, mesh_axes=mesh_axes, axis_size=axis_size,
+        use_barrier=use_barrier,
+    )
 
     @pl.when(jnp.logical_and(j == 0, i == 0))
     def _start():
-        if use_barrier:
-            barrier = pltpu.get_barrier_semaphore()
-            for delta in (-1, +1):
-                pltpu.semaphore_signal(
-                    barrier,
-                    inc=1,
-                    device_id=neighbor(delta),
-                    device_id_type=pltpu.DeviceIdType.MESH,
-                )
-            pltpu.semaphore_wait(barrier, 2)
-        copy_to_hi_neighbor().start()
-        copy_to_lo_neighbor().start()
+        start_rdma()
 
     # Waits, placed AFTER the whole interior sweep: the hi ghost ("plane
     # nx") is first read at step (0, nx), the lo ghost at (0, nx+1). Only
@@ -181,13 +214,11 @@ def _fused_kernel(
     # columns read the already-landed buffers.
     @pl.when(jnp.logical_and(j == 0, i == nx))
     def _wait_hi():
-        # send_sem[1] + recv_sem[1]: my HIGH ghost has landed
-        copy_to_lo_neighbor().wait()
+        wait_hi_ghost()
 
     @pl.when(jnp.logical_and(j == 0, i == nx + 1))
     def _wait_lo():
-        # send_sem[0] + recv_sem[0]: my LOW ghost has landed
-        copy_to_hi_neighbor().wait()
+        wait_lo_ghost()
 
     chunk = u_win[0]  # (by, nz)
     top, bot = _chunk_ghost_rows(chunk, top_ref, bot_ref, 1, periodic, bc)
@@ -402,3 +433,351 @@ def _fused_kernel_single(
         u_win, u_any, None, None, out_ref, glo_ref, ghi_ref, ring,
         send_sem, recv_sem, **params,
     )
+
+
+# ---------------------------------------------------------------------------
+# tb=2: the fused two-update superstep with the same DMA overlap.
+#
+# Same stream trick, width-2: the grid is (n_chunks, nx+8), and every step
+# stores ONE input "stream position" — local planes 0..nx-1 (phase A, the
+# overlap window), then the two HIGH ghost planes (positions nx, nx+1),
+# then the two LOW ghosts (-2, -1) and re-loads of planes 0..3 (the
+# epilogue). Mids (centered at the previous position) and outputs
+# (centered two back) fire wherever three contiguous stream positions are
+# resident, so phase A emits outputs 2..nx-3 from purely local data while
+# the four face planes fly over ICI; steps nx/nx+1 finish outputs
+# nx-2/nx-1 (first wait), and the epilogue recomputes mids -1..2 to emit
+# outputs 0/1 — the standard recompute-the-ghost-ring trick of the
+# temporally-blocked superstep, done inside the same kernel.
+
+
+def fused_dma2_supported(
+    local_shape: Tuple[int, int, int],
+    mesh_shape: Tuple[int, int, int],
+    taps: np.ndarray,
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    compute_itemsize: int = 4,
+) -> bool:
+    nx, ny, nz = local_shape
+    if nx < 4:
+        return False  # epilogue re-streams planes 0..3 as distinct planes
+    if mesh_shape[0] < 2 or mesh_shape[1] != 1 or mesh_shape[2] != 1:
+        return False
+    if 2 * 2 * _plane_bytes(ny, nz, in_itemsize) > _GHOST_BUDGET:
+        return False  # two width-2 ghost slabs resident
+    return (
+        choose_chunk(
+            local_shape, 2, in_itemsize, out_itemsize,
+            n_taps=effective_num_taps(taps),
+            compute_itemsize=compute_itemsize,
+        )
+        is not None
+    )
+
+
+def _fused2_kernel(
+    u_win,
+    u_any,
+    top_ref,
+    bot_ref,
+    out_ref,
+    glo_ref,
+    ghi_ref,
+    ring_a,
+    ring_b,
+    send_sem,
+    recv_sem,
+    *,
+    taps_flat,
+    nx,
+    by,
+    nz,
+    n_chunks,
+    axis_name,
+    mesh_axes,
+    axis_size,
+    periodic,
+    bc_value,
+    compute_dtype,
+    storage_dtype,
+    out_dtype,
+    use_barrier,
+):
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    bc_s = u_win.dtype.type(bc_value)
+    ny = by * n_chunks
+    my, start_rdma, wait_hi_ghost, wait_lo_ghost = _rdma_halo(
+        u_any, glo_ref, ghi_ref, send_sem, recv_sem, nx=nx, width=2,
+        axis_name=axis_name, mesh_axes=mesh_axes, axis_size=axis_size,
+        use_barrier=use_barrier,
+    )
+
+    @pl.when(jnp.logical_and(j == 0, i == 0))
+    def _start():
+        start_rdma()
+
+    # First reads of the ghost slabs: hi at step nx, lo at step nx+2.
+    @pl.when(jnp.logical_and(j == 0, i == nx))
+    def _wait_hi():
+        wait_hi_ghost()
+
+    @pl.when(jnp.logical_and(j == 0, i == nx + 2))
+    def _wait_lo():
+        wait_lo_ghost()
+
+    chunk = u_win[0]  # (by, nz)
+    top, bot = _chunk_ghost_rows(chunk, top_ref, bot_ref, 2, periodic, bc_s)
+    if not periodic:
+        top = jnp.where(j == 0, jnp.full_like(top, bc_s), top)
+        bot = jnp.where(j == n_chunks - 1, jnp.full_like(bot, bc_s), bot)
+
+    is_lo_edge = jnp.logical_and(jnp.logical_not(periodic), my == 0)
+    is_hi_edge = jnp.logical_and(
+        jnp.logical_not(periodic), my == axis_size - 1
+    )
+
+    def ghost_slab_chunk(ref, q):
+        return ref[q, pl.ds(j * by, by), :]
+
+    def ghost_slab_rows(ref, q):
+        """(2, nz) y-ghost rows above/below chunk j of ghost slab plane
+        ``q`` — domain wrap (periodic y is unsharded) or bc rows."""
+        def row(r):
+            if periodic:
+                return ref[q, pl.ds(lax.rem(r + ny, ny), 1), :]
+            fill = jnp.full((1, nz), bc_s, u_win.dtype)
+            oob = jnp.logical_or(r < 0, r >= ny)
+            return jnp.where(
+                oob, fill, ref[q, pl.ds(jnp.clip(r, 0, ny - 1), 1), :]
+            )
+
+        topg = lax.concatenate([row(j * by - 2), row(j * by - 1)], 0)
+        botg = lax.concatenate([row(j * by + by), row(j * by + by + 1)], 0)
+        return topg, botg
+
+    # Stream-position source per step: local planes (phase A and the
+    # epilogue re-loads arrive via the BlockSpec window), ghost slab
+    # planes at steps nx..nx+3. `ghost_x` marks DOMAIN ghost planes
+    # (Dirichlet edge devices only — elsewhere the DMA'd wrap content is
+    # real neighbor data).
+    is_ghost_step = jnp.logical_and(i >= nx, i <= nx + 3)
+    ghost_x = jnp.logical_or(
+        jnp.logical_and(is_hi_edge, jnp.logical_and(i >= nx, i <= nx + 1)),
+        jnp.logical_and(
+            is_lo_edge, jnp.logical_and(i >= nx + 2, i <= nx + 3)
+        ),
+    )
+    for k in range(3):
+
+        @pl.when(jnp.logical_and(
+            jnp.logical_not(is_ghost_step), lax.rem(i, 3) == k
+        ))
+        def _store_local(k=k):
+            _store_input_plane(
+                ring_a, k, chunk, top, bot, bc_s, periodic, 2,
+                ghost_x=jnp.zeros((), jnp.bool_),
+            )
+
+        for step_off, ref_sel, q in (
+            (0, "hi", 0), (1, "hi", 1), (2, "lo", 0), (3, "lo", 1)
+        ):
+
+            @pl.when(jnp.logical_and(i == nx + step_off, lax.rem(i, 3) == k))
+            def _store_ghost(k=k, ref_sel=ref_sel, q=q):
+                ref = ghi_ref if ref_sel == "hi" else glo_ref
+                gt, gb = ghost_slab_rows(ref, q)
+                _store_input_plane(
+                    ring_a, k, ghost_slab_chunk(ref, q), gt, gb, bc_s,
+                    periodic, 2, ghost_x=ghost_x,
+                )
+
+    # Mid centered at the previous stream position, from inputs at steps
+    # (i-2, i-1, i) in slots {-1: (i+1)%3, 0: (i+2)%3, +1: i%3}; stored in
+    # slot (i-1)%3 so three consecutive mids coexist. Fires wherever three
+    # CONTIGUOUS stream positions are resident: phase A + the high ghosts
+    # (steps 2..nx+1 -> mids 1..nx) and the epilogue re-stream (steps
+    # nx+4..nx+7 -> mids -1..2).
+    mid_fire = jnp.logical_or(
+        jnp.logical_and(i >= 2, i <= nx + 1), i >= nx + 4
+    )
+    # mid's stream-center position (phase A / epilogue mapping)
+    m_pos = jnp.where(i <= nx + 1, i - 1, i - (nx + 5))
+    # a domain-ghost mid plane (the intermediate's Dirichlet x-ghost):
+    # pinned to bc exactly as _fill_mid_ghosts sees it in the unfused
+    # superstep — only the edge devices' out-of-domain centers
+    mid_ghost = jnp.logical_or(
+        jnp.logical_and(is_lo_edge, m_pos == -1),
+        jnp.logical_and(is_hi_edge, m_pos == nx),
+    )
+    for k in range(3):  # k == i % 3
+
+        @pl.when(jnp.logical_and(mid_fire, lax.rem(i, 3) == k))
+        def _mid(k=k):
+            slots = {-1: (k + 1) % 3, 0: (k + 2) % 3, 1: k}
+            planes = {
+                d: ring_a[s].astype(compute_dtype) for d, s in slots.items()
+            }
+            mid = _plane_taps(
+                planes, taps_flat, by + 2, nz + 2, compute_dtype
+            )
+            slot = (k + 2) % 3  # == (i-1) % 3
+
+            @pl.when(mid_ghost)
+            def _bc_mid():
+                ring_b[slot] = jnp.full(
+                    (by + 2, nz + 2), bc_s, storage_dtype
+                )
+
+            @pl.when(jnp.logical_not(mid_ghost))
+            def _real_mid():
+                # round-trip through storage dtype so fused == unfused;
+                # Dirichlet pins the intermediate's domain ghost ring
+                # (lane columns always; rows on edge chunk columns)
+                ring_b[slot] = mid.astype(storage_dtype)
+                if not periodic:
+                    edge_col = jnp.full((by + 2, 1), bc_s, storage_dtype)
+                    ring_b[slot, :, 0:1] = edge_col
+                    ring_b[slot, :, nz + 1 : nz + 2] = edge_col
+                    edge_row = jnp.full((1, nz + 2), bc_s, storage_dtype)
+
+                    @pl.when(j == 0)
+                    def _top_row():
+                        ring_b[slot, 0:1, :] = edge_row
+
+                    @pl.when(j == n_chunks - 1)
+                    def _bot_row():
+                        ring_b[slot, by + 1 : by + 2, :] = edge_row
+
+    # Output centered two stream positions back, from mids stored at steps
+    # (i-2, i-1, i) in slots {-1: i%3, 0: (i+1)%3, +1: (i+2)%3}. Fires
+    # where three consecutive mids exist: steps 4..nx+1 (outputs 2..nx-1)
+    # and nx+6..nx+7 (outputs 0..1).
+    out_fire = jnp.logical_or(
+        jnp.logical_and(i >= 4, i <= nx + 1), i >= nx + 6
+    )
+    for k in range(3):
+
+        @pl.when(jnp.logical_and(out_fire, lax.rem(i, 3) == k))
+        def _out(k=k):
+            slots = {-1: k, 0: (k + 1) % 3, 1: (k + 2) % 3}
+            planes = {
+                d: ring_b[s].astype(compute_dtype) for d, s in slots.items()
+            }
+            res = _plane_taps(planes, taps_flat, by, nz, compute_dtype)
+            out_ref[0] = res.astype(out_dtype)
+
+
+def _fused2_kernel_single(
+    u_win, u_any, out_ref, glo_ref, ghi_ref, ring_a, ring_b, send_sem,
+    recv_sem, **params,
+):
+    """Single-chunk-column variant: no ghost-row refs (derived in-kernel)."""
+    _fused2_kernel(
+        u_win, u_any, None, None, out_ref, glo_ref, ghi_ref, ring_a,
+        ring_b, send_sem, recv_sem, **params,
+    )
+
+
+def apply_superstep_fused_dma(
+    u: jax.Array,
+    taps: np.ndarray,
+    *,
+    axis_name: str,
+    axis_size: int,
+    mesh_axes,
+    periodic: bool = False,
+    bc_value: float = 0.0,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """TWO fused stencil updates of an x-slab shard in one HBM sweep, with
+    the width-2 halo DMA overlapped under the phase-A interior sweep.
+    Must run inside shard_map over a mesh whose axis 0 has ``axis_size``
+    devices (axes 1/2 size 1)."""
+    nx, ny, nz = u.shape
+    out_dtype = out_dtype or u.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    flat = flat_taps(taps)
+    by = choose_chunk(
+        u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
+        n_taps=effective_num_taps(taps),
+        compute_itemsize=jnp.dtype(compute_dtype).itemsize,
+    )
+    if by is None:
+        raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
+    n_chunks = ny // by
+    single = n_chunks == 1
+
+    def x_of(i):
+        return jnp.where(
+            i <= nx - 1, i, jnp.clip(i - (nx + 4), 0, nx - 1)
+        )
+
+    def o_of(i):
+        return jnp.where(
+            i <= nx + 1,
+            jnp.clip(i - 2, 2, nx - 1),
+            jnp.where(i <= nx + 6, 0, 1),
+        )
+
+    kernel = functools.partial(
+        _fused2_kernel if not single else _fused2_kernel_single,
+        taps_flat=flat,
+        nx=nx,
+        by=by,
+        nz=nz,
+        n_chunks=n_chunks,
+        axis_name=axis_name,
+        mesh_axes=tuple(mesh_axes),
+        axis_size=axis_size,
+        periodic=periodic,
+        bc_value=bc_value,
+        compute_dtype=compute_dtype,
+        storage_dtype=u.dtype,
+        out_dtype=jnp.dtype(out_dtype),
+        use_barrier=not interpret,
+    )
+    in_specs = [
+        pl.BlockSpec((1, by, nz), lambda j, i: (x_of(i), j, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),  # DMA slab source
+    ]
+    operands = (u, u)
+    if not single:
+        in_specs += _row_block_specs(x_of, by, ny, nz, periodic)
+        operands = (u, u, u, u)
+    out, _glo, _ghi = pl.pallas_call(
+        kernel,
+        grid=(n_chunks, nx + 8),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((1, by, nz), lambda j, i: (o_of(i), j, 0)),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+            jax.ShapeDtypeStruct((2, ny, nz), u.dtype),  # low ghost slab
+            jax.ShapeDtypeStruct((2, ny, nz), u.dtype),  # high ghost slab
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((3, by + 4, nz + 4), u.dtype),
+            pltpu.VMEM((3, by + 2, nz + 2), u.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=_COLLECTIVE_ID,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * len(flat) * nx * ny * nz,
+            bytes_accessed=nx * ny * nz
+            * (u.dtype.itemsize + jnp.dtype(out_dtype).itemsize),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out

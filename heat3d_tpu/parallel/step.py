@@ -431,6 +431,44 @@ def _local_superstep_direct_faces(
     return out
 
 
+def _fused_dma_route(cfg: SolverConfig, tb: int):
+    """Shared resolver for the fused DMA-overlap routes: the tb=1 step
+    kernel or the tb=2 superstep kernel, or None when the config/env/scope
+    gates reject. One body so the two routes cannot drift."""
+    ok, interpret = _kernel_env_gate(cfg)
+    if not ok:
+        return None
+    try:
+        from heat3d_tpu.ops.stencil_dma_fused import (
+            apply_step_fused_dma,
+            apply_superstep_fused_dma,
+            fused_dma2_supported,
+            fused_dma_supported,
+        )
+    except ImportError:
+        return None
+    supported, apply_fn = (
+        (fused_dma_supported, apply_step_fused_dma)
+        if tb == 1
+        else (fused_dma2_supported, apply_superstep_fused_dma)
+    )
+    itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    if not supported(
+        cfg.local_shape,
+        cfg.mesh.shape,
+        _solver_taps(cfg),
+        itemsize,
+        itemsize,
+        jnp.dtype(cfg.precision.compute).itemsize,
+    ):
+        return None
+    import functools
+
+    if interpret:
+        return functools.partial(apply_fn, interpret=True)
+    return apply_fn
+
+
 def _fused_dma_fn(cfg: SolverConfig):
     """Return the fused DMA-overlap kernel entry for this config, or None.
 
@@ -445,31 +483,16 @@ def _fused_dma_fn(cfg: SolverConfig):
     overlap+halo='dma')."""
     if not (cfg.overlap and cfg.halo == "dma"):
         return None
-    ok, interpret = _kernel_env_gate(cfg)
-    if not ok:
-        return None
-    try:
-        from heat3d_tpu.ops.stencil_dma_fused import (
-            apply_step_fused_dma,
-            fused_dma_supported,
-        )
-    except ImportError:
-        return None
-    itemsize = jnp.dtype(cfg.precision.storage).itemsize
-    if not fused_dma_supported(
-        cfg.local_shape,
-        cfg.mesh.shape,
-        _solver_taps(cfg),
-        itemsize,
-        itemsize,
-        jnp.dtype(cfg.precision.compute).itemsize,
-    ):
-        return None
-    import functools
+    return _fused_dma_route(cfg, tb=1)
 
-    if interpret:
-        return functools.partial(apply_step_fused_dma, interpret=True)
-    return apply_step_fused_dma
+
+def _fused_dma2_fn(cfg: SolverConfig):
+    """The tb=2 analogue of _fused_dma_fn: the fused two-update superstep
+    with the width-2 halo DMA overlapped under the phase-A sweep, for
+    overlap=True + halo='dma' + time_blocking=2 on an x-slab mesh."""
+    if not (cfg.overlap and cfg.halo == "dma" and cfg.time_blocking == 2):
+        return None
+    return _fused_dma_route(cfg, tb=2)
 
 
 def _local_step_fused_dma(
@@ -651,10 +674,33 @@ def make_superstep_fn(
     halo transport (ppermute or the width-k DMA slab exchange); requires no
     overlap split and local extents >= k."""
     if cfg.overlap:
+        # One combination earns its keep: halo='dma' + tb=2 on an x-slab
+        # mesh, where the fused two-update kernel overlaps the width-2
+        # slab DMA under its phase-A sweep (the tb=2 form of the fused
+        # DMA-overlap route).
+        fused2 = _fused_dma2_fn(cfg)
+        if fused2 is not None:
+            _log_step_path_once(
+                "superstep path: fused DMA-overlap direct2 kernel "
+                "(width-2 slab RDMA under the sweep)"
+            )
+            taps2 = _solver_taps(cfg)
+            spec2 = P(*cfg.mesh.axis_names)
+
+            def local_fused2(u_local):
+                return _local_step_fused_dma(u_local, taps2, cfg, fused2)
+
+            return jax.shard_map(
+                local_fused2, mesh=mesh, in_specs=spec2, out_specs=spec2,
+                check_vma=False,
+            )
         raise ValueError(
             f"time_blocking={cfg.time_blocking} and overlap=True are "
             "mutually exclusive — the superstep already restructures the "
-            "exchange/compute schedule"
+            "exchange/compute schedule. The one supported combination is "
+            "the fused DMA-overlap superstep: halo='dma' + tb=2 on a 1D "
+            "x-slab mesh with >= 2 devices, local nx >= 4, unpadded "
+            "shards, on TPU"
         )
     if min(cfg.local_shape) < cfg.time_blocking:
         raise ValueError(

@@ -54,11 +54,14 @@ CONFIGS = [
      "ppermute", False),
     ("2+tb: 1024^3 slab, tb=2", 1024, (8, 1, 1), "7pt", Precision.fp32(), 2,
      "ppermute", False),
-    # the fused DMA-overlap kernel: zero collective_permutes by design —
+    # the fused DMA-overlap kernels: zero collective_permutes by design —
     # the halo rides kernel-initiated RDMA inside the one Mosaic custom
-    # call (SURVEY §7.1 item 7)
+    # call (SURVEY §7.1 item 7); tb=2 = the fused two-update superstep
+    # with the width-2 slab DMA under its phase-A sweep
     ("2+fused: 1024^3 slab, RDMA overlap", 1024, (8, 1, 1), "7pt",
      Precision.fp32(), 1, "dma", True),
+    ("2+fused2: 1024^3 slab, RDMA overlap tb=2", 1024, (8, 1, 1), "7pt",
+     Precision.fp32(), 2, "dma", True),
 ]
 
 

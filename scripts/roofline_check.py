@@ -46,9 +46,10 @@ def bytes_per_cell_update(row) -> tuple[float, str]:
     # recorded (exact even for HEAT3D_NO_DIRECT A/B rows); derive for
     # legacy rows.
     if row.get("fused_dma_path"):
-        # fused DMA-overlap kernel: unpadded streaming sweep (tb=1 only),
-        # same traffic shape as the direct kernels
-        return 2 * item, "fused-dma"
+        # fused DMA-overlap kernels: unpadded streaming sweep, one
+        # read+write per sweep of tb updates — same traffic shape as the
+        # direct kernels
+        return 2 * item / tb, f"fused-dma{'' if tb == 1 else '2'}"
     direct = row.get("direct_path")
     if direct is None:
         direct = halo == "ppermute" and tb in (1, 2)

@@ -606,6 +606,83 @@ def check_fused_dma_overlap_ring_interpret():
     )
 
 
+def check_fused_dma2_superstep_ring_interpret():
+    """The tb=2 fused DMA-overlap superstep (width-2 slab RDMA under the
+    phase-A sweep, epilogue recomputes the boundary mids) on a real
+    8-device ring == TWO single-device oracle steps — the same
+    mid-through-storage-dtype round trip as the unfused superstep. Same
+    1D-mesh interpret-mode scope as the other DMA tiers."""
+    from jax.sharding import Mesh, NamedSharding
+
+    import heat3d_tpu.ops.stencil_dma_fused as fused_mod
+    from heat3d_tpu.core.config import GridConfig
+    from heat3d_tpu.ops.stencil_jnp import step_single_device
+
+    grid = (32, 16, 16)  # 4 x-planes/shard: the tb=2 kernel's minimum
+    gc = GridConfig(shape=grid)
+    u_host = golden.random_init(grid, seed=41)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    spec = P("x")
+    orig_chunk = fused_mod.choose_chunk
+    tiers = [
+        (jnp.asarray(u_host), Precision(), 1e-6,
+         [(BoundaryCondition.DIRICHLET, 1.5),
+          (BoundaryCondition.PERIODIC, 0.0)]),
+        # bf16: the mid's bf16 storage round trip must match two unfused
+        # bf16 steps; 2 chained updates => 2 bf16 ulps
+        (jnp.asarray(u_host).astype(jnp.bfloat16), Precision.bf16(), 8e-3,
+         [(BoundaryCondition.DIRICHLET, 1.5)]),
+    ]
+    try:
+        for kind in ("7pt", "27pt"):
+            taps = stencil_taps(
+                STENCILS[kind], gc.alpha, gc.effective_dt(), gc.spacing
+            )
+            for u_in, prec, tol, bcs in tiers:
+                u_dev = jax.device_put(u_in, NamedSharding(mesh, spec))
+                for by in (None, 8):
+                    fused_mod.choose_chunk = (
+                        orig_chunk if by is None
+                        else lambda *a, _by=by, **k: _by
+                    )
+                    for bc, bcv in bcs:
+                        got = jax.jit(
+                            jax.shard_map(
+                                lambda x, t=taps,
+                                p=bc is BoundaryCondition.PERIODIC,
+                                v=bcv: fused_mod.apply_superstep_fused_dma(
+                                    x, t, axis_name="x", axis_size=8,
+                                    mesh_axes=("x",), periodic=p,
+                                    bc_value=v, interpret=True,
+                                ),
+                                mesh=mesh, in_specs=spec, out_specs=spec,
+                                check_vma=False,
+                            )
+                        )(u_dev)
+                        want = step_single_device(
+                            step_single_device(
+                                u_in, taps, bc, bcv, precision=prec
+                            ),
+                            taps, bc, bcv, precision=prec,
+                        )
+                        assert got.dtype == jnp.dtype(prec.storage)
+                        np.testing.assert_allclose(
+                            np.asarray(got.astype(jnp.float32)),
+                            np.asarray(want.astype(jnp.float32)),
+                            rtol=tol, atol=tol,
+                            err_msg=(
+                                f"tb2 {kind} dtype={prec.storage} "
+                                f"by={by} bc={bc}"
+                            ),
+                        )
+    finally:
+        fused_mod.choose_chunk = orig_chunk
+    print(
+        "fused_dma2_superstep_ring_interpret OK "
+        "(7pt+27pt, fp32+bf16, single+multi chunk)"
+    )
+
+
 def check_sharded_checkpoint_roundtrip():
     import tempfile
 
@@ -661,6 +738,7 @@ def main():
     check_multistep_vs_golden()
     check_dma_halo_ring_interpret()
     check_fused_dma_overlap_ring_interpret()
+    check_fused_dma2_superstep_ring_interpret()
     check_sharded_checkpoint_roundtrip()
     check_gather_slice_distributed()
     print("ALL MULTIDEVICE CHECKS PASSED")

@@ -24,8 +24,16 @@ from heat3d_tpu.core.config import (
     StencilConfig,
 )
 from heat3d_tpu.core.stencils import STENCILS, stencil_taps
-from heat3d_tpu.ops.stencil_dma_fused import fused_dma_supported
-from heat3d_tpu.parallel.step import _fused_dma_fn, make_step_fn
+from heat3d_tpu.ops.stencil_dma_fused import (
+    fused_dma2_supported,
+    fused_dma_supported,
+)
+from heat3d_tpu.parallel.step import (
+    _fused_dma2_fn,
+    _fused_dma_fn,
+    make_step_fn,
+    make_superstep_fn,
+)
 from heat3d_tpu.parallel.topology import abstract_mesh, lower_for_mesh
 
 
@@ -123,6 +131,79 @@ def test_fused_dma_multichunk_lowers_for_tpu(monkeypatch):
         step, cfg.mesh, (cfg.grid.shape, jnp.float32, P("x", "y", "z"))
     ).as_text()
     assert "tpu_custom_call" in txt
+
+
+def test_fused_dma2_supported_scope():
+    t7 = _taps("7pt", (32, 32, 32))
+    assert fused_dma2_supported((4, 32, 32), (8, 1, 1), t7)
+    assert fused_dma2_supported(
+        (4, 32, 32), (8, 1, 1), _taps("27pt", (32, 32, 32))
+    )
+    assert not fused_dma2_supported((3, 32, 32), (8, 1, 1), t7)  # nx < 4
+    assert not fused_dma2_supported((4, 32, 32), (2, 2, 2), t7)  # 3D block
+
+
+def test_fused_dma2_dispatch_gate(monkeypatch):
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(8, 1, 1)),
+        backend="auto",
+        halo="dma",
+        overlap=True,
+        time_blocking=2,
+    )
+    assert _fused_dma2_fn(cfg) is not None
+    import dataclasses
+
+    for kw in (
+        dict(time_blocking=1),
+        dict(halo="ppermute"),
+        dict(overlap=False),
+        dict(mesh=MeshConfig(shape=(2, 2, 2))),
+    ):
+        assert _fused_dma2_fn(dataclasses.replace(cfg, **kw)) is None
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_fused_dma2_superstep_lowers_for_multichip_tpu(kind, monkeypatch):
+    """make_superstep_fn dispatches the fused DMA-overlap tb=2 kernel on
+    the production 3-axis (8,1,1) mesh and lowers to Mosaic."""
+    monkeypatch.setenv("HEAT3D_DIRECT_FORCE", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        stencil=StencilConfig(kind=kind, bc=BoundaryCondition.DIRICHLET,
+                              bc_value=0.5),
+        mesh=MeshConfig(shape=(8, 1, 1)),
+        backend="auto",
+        halo="dma",
+        overlap=True,
+        time_blocking=2,
+    )
+    assert _fused_dma2_fn(cfg) is not None
+    am = abstract_mesh(cfg.mesh)
+    fn = make_superstep_fn(cfg, am)
+    txt = lower_for_mesh(
+        fn, cfg.mesh, (cfg.grid.shape, jnp.float32, P("x", "y", "z"))
+    ).as_text()
+    assert "tpu_custom_call" in txt
+
+
+def test_overlap_tb_out_of_scope_still_errors():
+    """Outside the fused tb=2 scope, overlap+time_blocking keeps the
+    mutual-exclusion config error."""
+    cfg = SolverConfig(
+        grid=GridConfig.cube(16),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        backend="jnp",
+        time_blocking=2,
+        overlap=True,
+    )
+    am = abstract_mesh(cfg.mesh)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_superstep_fn(cfg, am)
 
 
 def test_overlap_dma_out_of_scope_still_errors():
