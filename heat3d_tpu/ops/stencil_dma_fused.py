@@ -580,18 +580,23 @@ def _fused2_kernel(
                 ghost_x=jnp.zeros((), jnp.bool_),
             )
 
-        for step_off, ref_sel, q in (
-            (0, "hi", 0), (1, "hi", 1), (2, "lo", 0), (3, "lo", 1)
-        ):
+    # Ghost-slab stores sit OUTSIDE the ring-slot loop: `nx + step_off`
+    # is a Python int, so the slot is static — one traced body per ghost
+    # step instead of three (two statically-dead) per slot.
+    for step_off, ref_sel, q in (
+        (0, "hi", 0), (1, "hi", 1), (2, "lo", 0), (3, "lo", 1)
+    ):
 
-            @pl.when(jnp.logical_and(i == nx + step_off, lax.rem(i, 3) == k))
-            def _store_ghost(k=k, ref_sel=ref_sel, q=q):
-                ref = ghi_ref if ref_sel == "hi" else glo_ref
-                gt, gb = ghost_slab_rows(ref, q)
-                _store_input_plane(
-                    ring_a, k, ghost_slab_chunk(ref, q), gt, gb, bc_s,
-                    periodic, 2, ghost_x=ghost_x,
-                )
+        @pl.when(i == nx + step_off)
+        def _store_ghost(
+            k=(nx + step_off) % 3, ref_sel=ref_sel, q=q
+        ):
+            ref = ghi_ref if ref_sel == "hi" else glo_ref
+            gt, gb = ghost_slab_rows(ref, q)
+            _store_input_plane(
+                ring_a, k, ghost_slab_chunk(ref, q), gt, gb, bc_s,
+                periodic, 2, ghost_x=ghost_x,
+            )
 
     # Mid centered at the previous stream position, from inputs at steps
     # (i-2, i-1, i) in slots {-1: (i+1)%3, 0: (i+2)%3, +1: i%3}; stored in

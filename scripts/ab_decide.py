@@ -15,9 +15,11 @@ Usage::
     python scripts/ab_decide.py tpu_measure.log [more.log ...]
         [--all-sessions] [--min-win PCT]
 
-By default only lines after the LAST ``=== tpu_measure_all`` session
-header in each file are considered (a log accumulates many sessions;
-stale A/Bs from an older kernel would corrupt the decision).
+By default only lines after the LAST session header in each file are
+considered — any of the ``SESSION_HEADERS`` prefixes
+(``=== tpu_measure_all``, ``=== pod_ab_fused``) starts a session (a log
+accumulates many sessions; stale A/Bs from an older kernel would corrupt
+the decision).
 ``--min-win`` (default 5.0) is the speedup percentage below which the
 recommendation is "keep default" (measurement noise / not worth a flip).
 """
@@ -30,7 +32,9 @@ import json
 import re
 import sys
 
-SESSION_HEADER = "=== tpu_measure_all"
+# any of these starts a measurement session; scoping keeps only lines
+# after the LAST header present in the file (stale-session protection)
+SESSION_HEADERS = ("=== tpu_measure_all", "=== pod_ab_fused")
 _LINE = re.compile(r"^([A-Za-z0-9_=/. -]+?):\s*(\{.*\})\s*$")
 # bench-harness rows vs CLI summary lines (stage 3g logs the latter) name
 # the throughput metric differently; first present key wins
@@ -59,8 +63,13 @@ def parse_knobs(prefix: str) -> dict:
 
 def parse_lines(text: str, all_sessions: bool = False):
     """Yield (knobs, row) for every A/B line in the chosen session scope."""
-    if not all_sessions and SESSION_HEADER in text:
-        text = text[text.rindex(SESSION_HEADER):]
+    if not all_sessions:
+        cut = max(
+            (text.rindex(h) for h in SESSION_HEADERS if h in text),
+            default=None,
+        )
+        if cut is not None:
+            text = text[cut:]
     for line in text.splitlines():
         m = _LINE.match(line.strip())
         if not m:

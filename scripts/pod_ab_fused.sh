@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# THE pod question the fused DMA-overlap kernels exist to answer
+# (docs/POD_RUNBOOK.md §3): fused RDMA-under-the-sweep vs
+# faces-direct-over-ppermute, at tb=1 and the headline tb=2, on an x-slab
+# mesh. One command on a pod slice; single-host multi-chip works too.
+#
+# Usage: scripts/pod_ab_fused.sh [results.log]
+# Env: MESH ("Px 1 1", default "8 1 1" — the fused route's x-slab scope),
+#      GRIDS (default "512 1024"), STEPS (default 50), ROW_TIMEOUT (s),
+#      plus the usual multi-host flags via HEAT3D_BENCH_ARGS (e.g.
+#      "--coordinator host0:9999 --num-processes 2 --process-id $K").
+#
+# Output: ab_decide-parseable lines "fused=<0|1> tb=<1|2> grid=<G>: {row}"
+# appended to the log; finish with `python scripts/ab_decide.py <log>`
+# (pairs differing only in the `fused` knob decide the route).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+LOG="${1:-pod_ab_fused.log}"
+MESH="${MESH:-8 1 1}"
+echo "=== pod_ab_fused $(date -u +%FT%TZ) mesh=$MESH ===" | tee -a "$LOG"
+
+for grid in ${GRIDS:-512 1024}; do
+  for tb in 1 2; do
+    for fused in 0 1; do
+      args=(--grid "$grid" --steps "${STEPS:-50}" --mesh $MESH
+            --time-blocking "$tb" --bench throughput
+            ${HEAT3D_BENCH_ARGS:-})
+      # fused arm: RDMA inside the sweep kernel; control arm: the
+      # faces-direct step (bulk kernel + faces over async ppermutes —
+      # the default route, overlap implicit in its data independence)
+      [[ $fused == 1 ]] && args+=(--halo dma --overlap)
+      err=$(mktemp)
+      out=$(timeout -k 30 "${ROW_TIMEOUT:-1200}" \
+        python -m heat3d_tpu.bench "${args[@]}" 2>"$err" | tail -1)
+      rc=$?
+      if [[ -z $out ]]; then
+        # a lost arm must say why (off-TPU fused arm, OOM, wedge), not
+        # log an empty line ab_decide silently skips
+        out="(no row: rc=$rc — $(tail -1 "$err" | cut -c1-160))"
+      fi
+      rm -f "$err"
+      echo "fused=$fused tb=$tb grid=$grid: $out" | tee -a "$LOG"
+    done
+  done
+  # the judged halo p50 on real ICI rides along once per grid
+  err=$(mktemp)
+  out=$(timeout -k 30 "${ROW_TIMEOUT:-1200}" \
+    python -m heat3d_tpu.bench --grid "$grid" --mesh $MESH --bench halo \
+    ${HEAT3D_BENCH_ARGS:-} 2>"$err" | tail -1)
+  rc=$?
+  [[ -z $out ]] && out="(no row: rc=$rc — $(tail -1 "$err" | cut -c1-160))"
+  rm -f "$err"
+  echo "halo grid=$grid: $out" | tee -a "$LOG"
+done
+
+echo "--- decisions" | tee -a "$LOG"
+python scripts/ab_decide.py "$LOG" 2>&1 | tee -a "$LOG" || true
+echo "=== done $(date -u +%FT%TZ) ===" | tee -a "$LOG"
