@@ -65,9 +65,13 @@ from heat3d_tpu.ops.stencil_pallas_direct import (
 # well inside the chip's (ghosts are 4 MB each at 1024^2 fp32).
 _GHOST_BUDGET = 16 * 1024 * 1024
 
-# collective_id: the per-axis halo kernels use 0..2; this kernel is its own
-# collective class.
+# collective_id: the per-axis halo kernels use 0..2; each fused kernel is
+# its own collective class — distinct ids even though the two never
+# synchronize with each other, because make_multistep_fn compiles BOTH
+# (tb=2 superstep + tb=1 remainder step) into one program and the barrier
+# semaphore is keyed by id.
 _COLLECTIVE_ID = 3
+_COLLECTIVE_ID_TB2 = 4
 
 
 def fused_dma_supported(
@@ -775,7 +779,7 @@ def apply_superstep_fused_dma(
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True,
-            collective_id=_COLLECTIVE_ID,
+            collective_id=_COLLECTIVE_ID_TB2,
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * len(flat) * nx * ny * nz,
