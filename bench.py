@@ -246,7 +246,11 @@ def _measure_in_child(grid_edge=None, cpu=False, last_rung=False):
             except (ValueError, IndexError):
                 rec = None
             if isinstance(rec, dict) and "value" in rec:
-                detail = rec.setdefault("detail", {})
+                # a child row may carry a non-dict "detail" (malformed or
+                # legacy); overwrite rather than crash the salvage path
+                if not isinstance(rec.get("detail"), dict):
+                    rec["detail"] = {}
+                detail = rec["detail"]
                 detail["timed_out_after_result"] = round(timeout, 1)
                 # keep the claim diagnostic the raise would have carried: a
                 # SIGKILLed child's chip claim is stale and explains later

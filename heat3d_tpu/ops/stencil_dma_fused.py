@@ -57,6 +57,8 @@ from heat3d_tpu.ops.stencil_pallas_direct import (
     _row_block_specs,
     _store_framed_plane,
     _store_input_plane,
+    _tap_stack_bytes,
+    _vmem_bytes,
     choose_chunk,
 )
 
@@ -64,6 +66,42 @@ from heat3d_tpu.ops.stencil_pallas_direct import (
 # ring/pipeline budget; their own ceiling keeps the kernel's total VMEM
 # well inside the chip's (ghosts are 4 MB each at 1024^2 fp32).
 _GHOST_BUDGET = 16 * 1024 * 1024
+
+
+def _chip_vmem_budget() -> int:
+    """Whole-chip VMEM ceiling the COMBINED fused-kernel footprint (resident
+    ghosts + ring/pipeline + emit-chain scoped stack) is gated against.
+    Default 32 MiB — the v5p-class chips the pod route targets; on a
+    smaller-VMEM generation set HEAT3D_VMEM_BYTES so the gate rejects (and
+    dispatch falls back to faces-direct) instead of failing Mosaic
+    allocation at compile time."""
+    import os
+
+    return int(os.environ.get("HEAT3D_VMEM_BYTES", 32 * 1024 * 1024))
+
+
+def _fused_footprint_ok(
+    local_shape, halo, in_itemsize, out_itemsize, n_taps, compute_itemsize,
+    ghost_bytes,
+) -> bool:
+    """choose_chunk budgets the ring/pipeline and the tap stack against
+    separate ceilings; the resident ghost buffers live outside both. This
+    checks their SUM against the one chip budget, at the same ``by`` the
+    builder will pick, so the gate can never approve a shape whose combined
+    footprint cannot be allocated."""
+    by = choose_chunk(
+        local_shape, halo, in_itemsize, out_itemsize,
+        n_taps=n_taps, compute_itemsize=compute_itemsize,
+    )
+    if by is None:
+        return False
+    nz = local_shape[2]
+    total = (
+        ghost_bytes
+        + _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize)
+        + _tap_stack_bytes(by, nz, halo, n_taps, compute_itemsize)
+    )
+    return total <= _chip_vmem_budget()
 
 # collective_id: the per-axis halo kernels use 0..2; each fused kernel is
 # its own collective class — distinct ids even though the two never
@@ -91,15 +129,12 @@ def fused_dma_supported(
         return False  # the re-loaded planes 0/1 must be distinct x-planes
     if mesh_shape[0] < 2 or mesh_shape[1] != 1 or mesh_shape[2] != 1:
         return False  # scope: 1D slab decomposition along x
-    if 2 * _plane_bytes(ny, nz, in_itemsize) > _GHOST_BUDGET:
+    ghost_bytes = 2 * _plane_bytes(ny, nz, in_itemsize)
+    if ghost_bytes > _GHOST_BUDGET:
         return False
-    return (
-        choose_chunk(
-            local_shape, 1, in_itemsize, out_itemsize,
-            n_taps=effective_num_taps(taps),
-            compute_itemsize=compute_itemsize,
-        )
-        is not None
+    return _fused_footprint_ok(
+        local_shape, 1, in_itemsize, out_itemsize,
+        effective_num_taps(taps), compute_itemsize, ghost_bytes,
     )
 
 
@@ -468,15 +503,12 @@ def fused_dma2_supported(
         return False  # epilogue re-streams planes 0..3 as distinct planes
     if mesh_shape[0] < 2 or mesh_shape[1] != 1 or mesh_shape[2] != 1:
         return False
-    if 2 * 2 * _plane_bytes(ny, nz, in_itemsize) > _GHOST_BUDGET:
+    ghost_bytes = 2 * 2 * _plane_bytes(ny, nz, in_itemsize)
+    if ghost_bytes > _GHOST_BUDGET:
         return False  # two width-2 ghost slabs resident
-    return (
-        choose_chunk(
-            local_shape, 2, in_itemsize, out_itemsize,
-            n_taps=effective_num_taps(taps),
-            compute_itemsize=compute_itemsize,
-        )
-        is not None
+    return _fused_footprint_ok(
+        local_shape, 2, in_itemsize, out_itemsize,
+        effective_num_taps(taps), compute_itemsize, ghost_bytes,
     )
 
 

@@ -258,6 +258,18 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
         for bstart, bshape, bfn in blocks:
             if bstart == zero_start and bshape == shape:
                 continue
+            # same bounds check as the non-recovery branch: an out-of-range
+            # block would make fullmap[region] silently clip below, and the
+            # shape mismatch would then be misdiagnosed as a stale
+            # consolidated save instead of a stale different-grid file
+            hi = tuple(b + w for b, w in zip(bstart, bshape))
+            if any(l < 0 or h > n for l, h, n in zip(bstart, hi, shape)):
+                raise ValueError(
+                    f"checkpoint {path}: block {bfn} spans {bstart}..{hi}, "
+                    f"outside the manifest shape {shape} — stale file from "
+                    "a different-grid save; remove it or list 'shards' in "
+                    "the manifest"
+                )
             region = tuple(
                 slice(b, b + w) for b, w in zip(bstart, bshape)
             )

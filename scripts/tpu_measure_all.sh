@@ -107,12 +107,54 @@ SKIP_FY_AB=${SKIP_FY_AB:-}; SKIP_MEHRSTELLEN=${SKIP_MEHRSTELLEN:-}
     env HEAT3D_MEHRSTELLEN=1 python -m heat3d_tpu.cli --grid 64 \
     --stencil 27pt --steps 3 --time-blocking 2 \
   || SKIP_MEHRSTELLEN=1; }
-probe_kernel "halo-dma-w1" python -m heat3d_tpu.cli --grid 64 \
-    --halo dma --steps 3 || true
+# halo-dma probe failure flips the route off for the rest of the session:
+# SKIP_HALO_DMA gates any later dma-transport stage here, and the marker
+# line in $LOG is what a pod operator checks before pod_ab_fused.sh
+# (docs/POD_RUNBOOK.md §3 orders the control arm first for this reason).
+SKIP_HALO_DMA=${SKIP_HALO_DMA:-}
+[[ -z $SKIP_HALO_DMA ]] && { probe_kernel "halo-dma-w1" \
+    python -m heat3d_tpu.cli --grid 64 --halo dma --steps 3 \
+  || { SKIP_HALO_DMA=1
+       echo "route-disabled: halo=dma (probe failed)" | tee -a "$LOG"; }; }
 [[ -z ${SKIP_BF16_COMPUTE:-} ]] && { probe_kernel "bf16-compute-tb2" \
     python -m heat3d_tpu.cli --grid 64 --dtype bf16 --compute-dtype bf16 \
     --time-blocking 2 --steps 3 \
   || export SKIP_BF16_COMPUTE=1; }
+# Fused DMA-overlap probes (the route pod_ab_fused.sh measures): need an
+# x-slab mesh of >= 2 chips — probed here ONLY on a multi-chip host so a
+# Mosaic surprise in the fused kernels surfaces as one bounded probe, not
+# mid-A/B. Single-chip sessions leave them unvetted by construction. The
+# device-count probe itself takes a chip claim, so it only runs when its
+# result can matter (no SKIP flag already set).
+SKIP_FUSED_DMA=${SKIP_FUSED_DMA:-}
+if [[ -z $SKIP_HALO_DMA && -z $SKIP_FUSED_DMA ]]; then
+  # empty NCHIPS = probe unreachable (distinct from a genuine count; the
+  # routes then stay enabled, unvetted — probe_kernel's own contract)
+  NCHIPS=$(python - <<'EOF'
+from heat3d_tpu.utils.backendprobe import probe_device_count
+n = probe_device_count()
+print("" if n is None else n)
+EOF
+)
+  if [[ -z $NCHIPS ]]; then
+    echo "fused-dma probes: tunnel unreachable for device count — routes stay enabled, unvetted" \
+      | tee -a "$LOG"
+  elif [[ $NCHIPS -lt 2 ]]; then
+    echo "fused-dma probes skipped: $NCHIPS chip(s) — route needs an x-slab mesh" \
+      | tee -a "$LOG"
+  else
+    probe_kernel "fused-dma-tb1" \
+        python -m heat3d_tpu.cli --grid 64 --mesh "$NCHIPS" 1 1 \
+        --halo dma --overlap --steps 3 \
+      || { SKIP_FUSED_DMA=1
+           echo "route-disabled: fused-dma tb=1 (probe failed)" | tee -a "$LOG"; }
+    [[ -z $SKIP_FUSED_DMA ]] && { probe_kernel "fused-dma-tb2" \
+        python -m heat3d_tpu.cli --grid 64 --mesh "$NCHIPS" 1 1 \
+        --halo dma --overlap --time-blocking 2 --steps 3 \
+      || { SKIP_FUSED_DMA=1
+           echo "route-disabled: fused-dma tb=2 (probe failed)" | tee -a "$LOG"; }; }
+  fi
+fi
 
 echo "--- stage 3: bench suite" | tee -a "$LOG"
 # The suite probe-gates each row internally; its stderr log (suite: ...

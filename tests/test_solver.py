@@ -347,6 +347,29 @@ def test_checkpoint_consolidate_rerun_recovers(tmp_path):
         ckpt.consolidate(str(path))
 
 
+def test_checkpoint_consolidate_recovery_out_of_range_block(tmp_path):
+    """The RECOVERY branch (full-shape zero block present) must diagnose a
+    listed partial that reaches past the global shape as out-of-range, not
+    let the mmap region silently clip and misreport it as a stale
+    consolidated save (regression: round-4 advisor finding)."""
+    from heat3d_tpu.utils import checkpoint as ckpt
+
+    full = np.arange(16 ** 3, dtype=np.float32).reshape(16, 16, 16)
+    path = tmp_path / "ckoor"
+    path.mkdir()
+    np.save(path / ckpt._shard_filename((0, 0, 0)), full)
+    # listed partial from a different-grid save: spans rows 0..16 in z of a
+    # 16-wide axis when started at 12 — out of range, never comparable
+    np.save(path / ckpt._shard_filename((0, 0, 12)),
+            np.zeros((16, 16, 8), np.float32))
+    (path / ckpt.MANIFEST).write_text(json.dumps({
+        "step": 2, "global_shape": [16, 16, 16], "dtype": "float32",
+        "format": 1, "shards": [[0, 0, 0], [0, 0, 12]], "extra": {},
+    }))
+    with pytest.raises(ValueError, match="outside the manifest shape"):
+        ckpt.consolidate(str(path))
+
+
 def test_cli_exact_step_count_and_periodic_checkpoint(tmp_path, capsys):
     # --steps N must run exactly N updates even with --residual-every, and
     # --checkpoint-every must fire on its grid (regression: review findings).
