@@ -57,8 +57,6 @@ from heat3d_tpu.ops.stencil_pallas_direct import (
     _row_block_specs,
     _store_framed_plane,
     _store_input_plane,
-    _tap_stack_bytes,
-    _vmem_bytes,
     choose_chunk,
 )
 
@@ -80,28 +78,26 @@ def _chip_vmem_budget() -> int:
     return int(os.environ.get("HEAT3D_VMEM_BYTES", 32 * 1024 * 1024))
 
 
-def _fused_footprint_ok(
+def _fused_choose_chunk(
     local_shape, halo, in_itemsize, out_itemsize, n_taps, compute_itemsize,
-    ghost_bytes,
-) -> bool:
-    """choose_chunk budgets the ring/pipeline and the tap stack against
-    separate ceilings; the resident ghost buffers live outside both. This
-    checks their SUM against the one chip budget, at the same ``by`` the
-    builder will pick, so the gate can never approve a shape whose combined
-    footprint cannot be allocated."""
-    by = choose_chunk(
+):
+    """The fused kernels' chunk chooser: choose_chunk's separate
+    ring/stack ceilings PLUS the combined whole-chip constraint with the
+    resident ghost buffers (which live outside the ring budget) as the
+    reserve — so ``by`` shrinks to a combined-feasible size on
+    smaller-VMEM chips rather than the route being rejected. The ONE
+    entry both the dispatch gates and the kernel builders call, so they
+    cannot drift. Returns ``by`` or None (ghost budget busted or no
+    feasible chunking)."""
+    ny, nz = local_shape[1], local_shape[2]
+    ghost_bytes = 2 * halo * _plane_bytes(ny, nz, in_itemsize)
+    if ghost_bytes > _GHOST_BUDGET:
+        return None
+    return choose_chunk(
         local_shape, halo, in_itemsize, out_itemsize,
         n_taps=n_taps, compute_itemsize=compute_itemsize,
+        reserve_bytes=ghost_bytes, total_budget=_chip_vmem_budget(),
     )
-    if by is None:
-        return False
-    nz = local_shape[2]
-    total = (
-        ghost_bytes
-        + _vmem_bytes(by, nz, halo, in_itemsize, out_itemsize)
-        + _tap_stack_bytes(by, nz, halo, n_taps, compute_itemsize)
-    )
-    return total <= _chip_vmem_budget()
 
 # collective_id: the per-axis halo kernels use 0..2; each fused kernel is
 # its own collective class — distinct ids even though the two never
@@ -129,12 +125,12 @@ def fused_dma_supported(
         return False  # the re-loaded planes 0/1 must be distinct x-planes
     if mesh_shape[0] < 2 or mesh_shape[1] != 1 or mesh_shape[2] != 1:
         return False  # scope: 1D slab decomposition along x
-    ghost_bytes = 2 * _plane_bytes(ny, nz, in_itemsize)
-    if ghost_bytes > _GHOST_BUDGET:
-        return False
-    return _fused_footprint_ok(
-        local_shape, 1, in_itemsize, out_itemsize,
-        effective_num_taps(taps), compute_itemsize, ghost_bytes,
+    return (
+        _fused_choose_chunk(
+            local_shape, 1, in_itemsize, out_itemsize,
+            effective_num_taps(taps), compute_itemsize,
+        )
+        is not None
     )
 
 
@@ -379,10 +375,9 @@ def apply_step_fused_dma(
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
     flat = flat_taps(taps)
-    by = choose_chunk(
+    by = _fused_choose_chunk(
         u.shape, 1, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
-        n_taps=effective_num_taps(taps),
-        compute_itemsize=jnp.dtype(compute_dtype).itemsize,
+        effective_num_taps(taps), jnp.dtype(compute_dtype).itemsize,
     )
     if by is None:
         raise ValueError(f"no VMEM-feasible chunking for {u.shape}")
@@ -503,12 +498,12 @@ def fused_dma2_supported(
         return False  # epilogue re-streams planes 0..3 as distinct planes
     if mesh_shape[0] < 2 or mesh_shape[1] != 1 or mesh_shape[2] != 1:
         return False
-    ghost_bytes = 2 * 2 * _plane_bytes(ny, nz, in_itemsize)
-    if ghost_bytes > _GHOST_BUDGET:
-        return False  # two width-2 ghost slabs resident
-    return _fused_footprint_ok(
-        local_shape, 2, in_itemsize, out_itemsize,
-        effective_num_taps(taps), compute_itemsize, ghost_bytes,
+    return (
+        _fused_choose_chunk(
+            local_shape, 2, in_itemsize, out_itemsize,
+            effective_num_taps(taps), compute_itemsize,
+        )
+        is not None
     )
 
 
@@ -742,10 +737,9 @@ def apply_superstep_fused_dma(
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
     flat = flat_taps(taps)
-    by = choose_chunk(
+    by = _fused_choose_chunk(
         u.shape, 2, u.dtype.itemsize, jnp.dtype(out_dtype).itemsize,
-        n_taps=effective_num_taps(taps),
-        compute_itemsize=jnp.dtype(compute_dtype).itemsize,
+        effective_num_taps(taps), jnp.dtype(compute_dtype).itemsize,
     )
     if by is None:
         raise ValueError(f"no VMEM-feasible chunking for {u.shape}")

@@ -139,13 +139,22 @@ def choose_chunk(
     n_taps: int = 7,
     compute_itemsize: int = 4,
     q_ring: bool = False,
+    reserve_bytes: int = 0,
+    total_budget: Optional[int] = None,
 ) -> Optional[int]:
     """Largest y-chunk height ``by`` (a divisor of ny, multiple of 8 when
     ny >= 8) whose working set fits the VMEM budget — both the explicit
     ring/pipeline buffers (including the mehrstellen q-ring when
     ``q_ring``) and the emit chain's scoped stack — or None. ``q_ring``
     overrides ``n_taps`` with the mehrstellen stack size here, in ONE
-    place, so the dispatch gate and the kernel builder can't drift."""
+    place, so the dispatch gate and the kernel builder can't drift.
+
+    ``total_budget`` (with ``reserve_bytes``) adds a COMBINED whole-chip
+    constraint on top of the separate ring/stack ceilings: reserve +
+    ring/pipeline + stack <= total_budget. The fused-DMA kernels pass
+    their resident ghost-buffer bytes as the reserve so ``by`` shrinks to
+    a combined-feasible size instead of the route being rejected outright
+    (gate and builder must pass identical values)."""
     if q_ring:
         n_taps = _MEHRSTELLEN_STACK_PLANES
     ny, nz = local_shape[1], local_shape[2]
@@ -157,16 +166,19 @@ def choose_chunk(
             # (_row_block_specs); only the full-extent single chunk may be
             # unaligned
             continue
+        ring = _vmem_bytes(
+            by, nz, halo, in_itemsize, out_itemsize,
+            q_itemsize=compute_itemsize if q_ring else 0,
+        )
+        stack = _tap_stack_bytes(by, nz, halo, n_taps, compute_itemsize)
+        if ring > _VMEM_BUDGET or stack > _TAP_STACK_BUDGET:
+            continue
         if (
-            _vmem_bytes(
-                by, nz, halo, in_itemsize, out_itemsize,
-                q_itemsize=compute_itemsize if q_ring else 0,
-            )
-            <= _VMEM_BUDGET
-            and _tap_stack_bytes(by, nz, halo, n_taps, compute_itemsize)
-            <= _TAP_STACK_BUDGET
+            total_budget is not None
+            and reserve_bytes + ring + stack > total_budget
         ):
-            return by
+            continue
+        return by
     return None
 
 

@@ -143,14 +143,18 @@ EOF
     echo "fused-dma probes skipped: $NCHIPS chip(s) — route needs an x-slab mesh" \
       | tee -a "$LOG"
   else
+    # grid scales with the slab so local nx = 8 >= the kernels' gates
+    # (tb=1 needs nx >= 2, tb=2 nx >= 4) — a fixed grid would leave the
+    # probe vacuous (non-fused fallback route "ok") on larger slices
+    FUSED_GRID=$((8 * NCHIPS))
     probe_kernel "fused-dma-tb1" \
-        python -m heat3d_tpu.cli --grid 64 --mesh "$NCHIPS" 1 1 \
+        python -m heat3d_tpu.cli --grid "$FUSED_GRID" --mesh "$NCHIPS" 1 1 \
         --halo dma --overlap --steps 3 \
       || { SKIP_FUSED_DMA=1
            echo "route-disabled: fused-dma tb=1 (probe failed)" | tee -a "$LOG"; }
     [[ -z $SKIP_FUSED_DMA ]] && { probe_kernel "fused-dma-tb2" \
-        python -m heat3d_tpu.cli --grid 64 --mesh "$NCHIPS" 1 1 \
-        --halo dma --overlap --time-blocking 2 --steps 3 \
+        python -m heat3d_tpu.cli --grid "$FUSED_GRID" --mesh "$NCHIPS" 1 1 \
+        --halo dma --overlap --time-blocking 2 --steps 4 \
       || { SKIP_FUSED_DMA=1
            echo "route-disabled: fused-dma tb=2 (probe failed)" | tee -a "$LOG"; }; }
   fi

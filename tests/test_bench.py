@@ -378,9 +378,11 @@ def test_chain_ops_tracks_mehrstellen_route(monkeypatch):
 
 
 def test_best_committed_tpu_record_filters(tmp_path):
-    """The CPU-fallback line attaches the best committed ON-CHIP 7pt row:
-    cpu-platform, RTT-dominated, small-grid, and non-7pt rows are
-    excluded; legacy rows without a platform field count as on-chip."""
+    """The CPU-fallback line attaches the best committed ON-CHIP row per
+    (stencil, dtype): cpu-platform, RTT-dominated, and small-grid rows are
+    excluded; 27pt rows land under their own 27pt_* keys (judged config 4
+    survives an outage round); legacy rows without a platform field count
+    as on-chip."""
     import importlib.util, os
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -402,7 +404,10 @@ def test_best_committed_tpu_record_filters(tmp_path):
          "gcell_per_sec_per_chip": 500.0},                      # small: drop
         {"bench": "throughput", "stencil": "27pt", "grid": [1024] * 3,
          "platform": "tpu", "dtype": "float32",
-         "gcell_per_sec_per_chip": 400.0},                      # 27pt: drop
+         "gcell_per_sec_per_chip": 30.9},                       # 27pt: own key
+        {"bench": "throughput", "stencil": "27pt", "grid": [512] * 3,
+         "platform": "tpu", "dtype": "float32",
+         "gcell_per_sec_per_chip": 24.8},                       # slower 27pt: drop
         {"bench": "throughput", "stencil": "7pt", "grid": [512] * 3,
          "platform": "tpu", "rtt_dominated": True, "dtype": "float32",
          "gcell_per_sec_per_chip": 300.0},                      # rtt: drop
@@ -413,8 +418,12 @@ def test_best_committed_tpu_record_filters(tmp_path):
     assert best == {
         "fp32": {
             "gcell_per_sec_per_chip": 103.1, "grid": 1024,
-            "dtype": "float32", "time_blocking": 2,
-        }
+            "stencil": "7pt", "dtype": "float32", "time_blocking": 2,
+        },
+        "27pt_fp32": {
+            "gcell_per_sec_per_chip": 30.9, "grid": 1024,
+            "stencil": "27pt", "dtype": "float32", "time_blocking": 1,
+        },
     }
     assert bench._best_committed_tpu_record(str(tmp_path / "nope")) is None
 
