@@ -125,15 +125,23 @@ def bench_throughput(
 
 def _resolved_fused_dma(cfg: SolverConfig) -> bool:
     """Whether this config's hot path resolves to a fused DMA-overlap
-    kernel (parallel.step._fused_dma_fn / _fused_dma2_fn —
-    overlap+halo='dma', x-slab scope; tb=2 rows resolve the superstep
-    form since that is what the time loop runs)."""
-    from heat3d_tpu.parallel.step import _fused_dma2_fn, _fused_dma_fn
+    kernel (parallel.step._fused_dma_fn / _fused_dma_3d_fn /
+    _fused_dma2_fn — overlap+halo='dma'; slab scope, the x-sharded block
+    generalization, or the tb=2 superstep form, matching what the time
+    loop runs)."""
+    from heat3d_tpu.parallel.step import (
+        _fused_dma2_fn,
+        _fused_dma_3d_fn,
+        _fused_dma_fn,
+    )
 
     if cfg.time_blocking == 2:
         return _fused_dma2_fn(cfg) is not None
     if cfg.time_blocking == 1:
-        return _fused_dma_fn(cfg) is not None
+        return (
+            _fused_dma_fn(cfg) is not None
+            or _fused_dma_3d_fn(cfg) is not None
+        )
     return False
 
 

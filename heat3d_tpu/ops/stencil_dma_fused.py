@@ -134,6 +134,101 @@ def fused_dma_supported(
     )
 
 
+def fused_dma_3d_supported(
+    local_shape: Tuple[int, int, int],
+    mesh_shape: Tuple[int, int, int],
+    taps: np.ndarray,
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    compute_itemsize: int = 4,
+) -> bool:
+    """Scope gate for the 3D-block generalization of the fused DMA-overlap
+    step (parallel/step._local_step_fused_dma_3d): a mesh sharded along x
+    (>= 2 devices) AND at least one of y/z — the judged block
+    decompositions (BASELINE.json configs 3-5). The kernel itself is the
+    unchanged x-slab kernel (its in-register y/z frame synthesis is wrong
+    only in the outermost shell of each sharded y/z axis, which the step
+    recomputes from ppermute'd faces and patches); the pure x-slab scope
+    stays with ``fused_dma_supported`` so the two dispatch routes are
+    mutually exclusive."""
+    nx, ny, nz = local_shape
+    if nx < 2:
+        return False
+    if mesh_shape[0] < 2 or (mesh_shape[1] == 1 and mesh_shape[2] == 1):
+        return False  # x-sharded 3D/2D blocks only; x-slabs use the
+        # dedicated route (no shell patches)
+    return (
+        _fused_choose_chunk(
+            local_shape, 1, in_itemsize, out_itemsize,
+            effective_num_taps(taps), compute_itemsize,
+        )
+        is not None
+    )
+
+
+def substitute_dirichlet_x_edges(
+    glo, ghi, *, axis_name, axis_size, periodic, bc_value
+):
+    """The READ side of the ghost-landing contract, in ONE place: the
+    RDMA ring copy always runs (torus-symmetric, keeping the semaphores
+    drained), so at Dirichlet x-edge devices the landed buffers hold wrap
+    data and every consumer — the kernel in-register, the reference
+    contract, the 3D route's shell-patch glue — must substitute bc_value
+    before reading. Periodic rings pass through (wrap data is genuine)."""
+    if periodic:
+        return glo, ghi
+    my = lax.axis_index(axis_name)
+    bc = jnp.asarray(bc_value, glo.dtype)
+    glo = jnp.where(my == 0, jnp.full_like(glo, bc), glo)
+    ghi = jnp.where(my == axis_size - 1, jnp.full_like(ghi, bc), ghi)
+    return glo, ghi
+
+
+def reference_fused_step_xla(
+    u, taps, *, axis_name, axis_size, mesh_axes, periodic, bc_value,
+    compute_dtype=jnp.float32, out_dtype=None, return_ghosts=False,
+    interpret=True,
+):
+    """Pure-XLA reference implementation of apply_step_fused_dma's
+    CONTRACT, used to certify the 3D route's glue on multi-axis CPU
+    meshes (jax-0.9 interpret mode cannot discharge remote DMA on a
+    >1-named-axis mesh; the kernel's own RDMA mechanics are certified on
+    the 1D ring, where interpret works — tests/multidevice_checks.py).
+
+    Semantics mirrored exactly: the x ghost planes arrive by torus ring
+    transfer (the landed buffers hold wrap data even at Dirichlet
+    x-edges), Dirichlet x-edge devices READ bc_value instead, and every
+    plane's y/z frame — the ghost planes' included — is synthesized as a
+    DOMAIN boundary (local wrap / bc), which the 3D route's shell patches
+    then correct on sharded y/z axes."""
+    from heat3d_tpu.ops.stencil_jnp import apply_taps_padded
+
+    out_dtype = out_dtype or u.dtype
+    nx = u.shape[0]
+    ring_fwd = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    ring_bwd = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+    glo = lax.ppermute(u[nx - 1 : nx], axis_name, ring_fwd)
+    ghi = lax.ppermute(u[0:1], axis_name, ring_bwd)
+    rlo, rhi = substitute_dirichlet_x_edges(
+        glo, ghi, axis_name=axis_name, axis_size=axis_size,
+        periodic=periodic, bc_value=bc_value,
+    )
+    stack = jnp.concatenate([rlo, u, rhi], axis=0)  # (nx+2, ny, nz)
+    if periodic:
+        padded = jnp.pad(stack, ((0, 0), (1, 1), (1, 1)), mode="wrap")
+    else:
+        padded = jnp.pad(
+            stack, ((0, 0), (1, 1), (1, 1)),
+            constant_values=np.asarray(bc_value),
+        )
+    out = apply_taps_padded(
+        padded, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+    )
+    if return_ghosts:
+        return out, glo[0], ghi[0]
+    return out
+
+
 def _rdma_halo(
     u_any, glo_ref, ghi_ref, send_sem, recv_sem, *, nx, width,
     axis_name, mesh_axes, axis_size, use_barrier,
@@ -367,10 +462,21 @@ def apply_step_fused_dma(
     compute_dtype=jnp.float32,
     out_dtype=None,
     interpret: bool = False,
+    return_ghosts: bool = False,
 ) -> jax.Array:
     """One stencil update of an x-slab shard with kernel-initiated halo
     DMA overlapped under the sweep. Must run inside shard_map over a mesh
-    whose axis 0 has ``axis_size`` devices (axes 1/2 size 1)."""
+    whose axis 0 has ``axis_size`` devices; axes 1/2 may be sharded too
+    when the caller patches the y/z shells (the 3D route,
+    ``fused_dma_3d_supported`` — the kernel treats y/z as domain
+    boundaries either way).
+
+    ``return_ghosts=True`` additionally returns the two landed ghost
+    planes ``(out, glo, ghi)``, each (ny, nz) — the x-neighbor faces the
+    RDMA delivered. NOTE: on Dirichlet x-edge devices the buffers hold the
+    torus wrap transfer (the ring copy always runs to keep the semaphores
+    drained); the kernel substitutes bc_value when READING, and a caller
+    reusing the buffers (the 3D route's shell patches) must do the same."""
     nx, ny, nz = u.shape
     out_dtype = out_dtype or u.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
@@ -424,7 +530,7 @@ def apply_step_fused_dma(
     if not single:
         in_specs += _row_block_specs(x_of, by, ny, nz, periodic)
         operands = (u, u, u, u)
-    out, _glo, _ghi = pl.pallas_call(
+    out, glo, ghi = pl.pallas_call(
         kernel,
         grid=(n_chunks, nx + 4),
         in_specs=in_specs,
@@ -455,6 +561,8 @@ def apply_step_fused_dma(
         ),
         interpret=interpret,
     )(*operands)
+    if return_ghosts:
+        return out, glo, ghi
     return out
 
 

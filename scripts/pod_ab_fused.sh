@@ -5,7 +5,11 @@
 # mesh. One command on a pod slice; single-host multi-chip works too.
 #
 # Usage: scripts/pod_ab_fused.sh [results.log]
-# Env: MESH ("Px 1 1", default "8 1 1" — the fused route's x-slab scope),
+# Env: MESH (default "8 1 1" — the fused route's x-slab scope; an
+#      x-sharded BLOCK mesh like "2 2 2" exercises the 3D route instead:
+#      RDMA-x under the sweep + y/z face ppermutes + shell patches, tb=1
+#      arms only — tb=2 on a block mesh falls back with a config error,
+#      which the log line records as "(no row: ...)", expected),
 #      GRIDS (default "512 1024"), STEPS (default 50), ROW_TIMEOUT (s),
 #      plus the usual multi-host flags via HEAT3D_BENCH_ARGS (e.g.
 #      "--coordinator host0:9999 --num-processes 2 --process-id $K").
@@ -18,11 +22,20 @@ cd "$(dirname "$0")/.."
 
 LOG="${1:-pod_ab_fused.log}"
 MESH="${MESH:-8 1 1}"
-echo "=== pod_ab_fused $(date -u +%FT%TZ) mesh=$MESH ===" | tee -a "$LOG"
+# slab = axes 1/2 unsharded; block meshes run the 3D route, whose fused
+# scope is tb=1 only (the tb=2 superstep keeps faces-direct there)
+read -r _mx _my _mz <<<"$MESH"
+SLAB=$([[ "${_my:-1}" == 1 && "${_mz:-1}" == 1 ]] && echo 1 || echo 0)
+echo "=== pod_ab_fused $(date -u +%FT%TZ) mesh=$MESH slab=$SLAB ===" | tee -a "$LOG"
 
 for grid in ${GRIDS:-512 1024}; do
   for tb in 1 2; do
     for fused in 0 1; do
+      if [[ $fused == 1 && $tb == 2 && $SLAB == 0 ]]; then
+        echo "fused=1 tb=2 grid=$grid: skipped (block mesh: fused tb=2 out of scope)" \
+          | tee -a "$LOG"
+        continue
+      fi
       args=(--grid "$grid" --steps "${STEPS:-50}" --mesh $MESH
             --time-blocking "$tb" --bench throughput
             ${HEAT3D_BENCH_ARGS:-})

@@ -329,9 +329,10 @@ def test_throughput_row_records_resolved_direct_path(monkeypatch):
 
 def test_throughput_row_records_resolved_fused_dma_path(monkeypatch):
     """fused_dma_path records the REAL fused-route selector's decision:
-    True for an in-scope overlap+halo='dma' x-slab config (interpret mode
-    stands in for TPU off-chip), False for ppermute transport or a 3D
-    mesh — so pod A/B rows vs faces-direct stay tellable apart."""
+    True for an in-scope overlap+halo='dma' config — the x-slab kernel OR
+    the x-sharded block generalization (interpret mode stands in for TPU
+    off-chip) — False for ppermute transport or an x-unsharded mesh, so
+    pod A/B rows vs faces-direct stay tellable apart."""
     import dataclasses
 
     from heat3d_tpu.bench.harness import _resolved_fused_dma
@@ -346,8 +347,12 @@ def test_throughput_row_records_resolved_fused_dma_path(monkeypatch):
     )
     assert _resolved_fused_dma(cfg) is True
     assert _resolved_fused_dma(dataclasses.replace(cfg, halo="ppermute")) is False
+    # the 3D route (block mesh) resolves too — its rows are fused-arm rows
     assert _resolved_fused_dma(
         dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 2, 2)))
+    ) is True
+    assert _resolved_fused_dma(
+        dataclasses.replace(cfg, mesh=MeshConfig(shape=(1, 2, 4)))
     ) is False
 
 

@@ -26,10 +26,12 @@ from heat3d_tpu.core.config import (
 from heat3d_tpu.core.stencils import STENCILS, stencil_taps
 from heat3d_tpu.ops.stencil_dma_fused import (
     fused_dma2_supported,
+    fused_dma_3d_supported,
     fused_dma_supported,
 )
 from heat3d_tpu.parallel.step import (
     _fused_dma2_fn,
+    _fused_dma_3d_fn,
     _fused_dma_fn,
     make_step_fn,
     make_superstep_fn,
@@ -54,6 +56,86 @@ def test_fused_dma_supported_scope():
     assert fused_dma_supported(
         (4, 32, 32), (8, 1, 1), _taps("27pt", (32, 32, 32))
     )
+
+
+def test_fused_dma_3d_supported_scope():
+    """The 3D-block gate: x-sharded meshes with a sharded y or z axis —
+    mutually exclusive with the x-slab gate so dispatch is unambiguous."""
+    t7 = _taps("7pt", (32, 32, 32))
+    t27 = _taps("27pt", (32, 32, 32))
+    for taps in (t7, t27):
+        assert fused_dma_3d_supported((4, 32, 32), (2, 2, 2), taps)
+        assert fused_dma_3d_supported((4, 32, 32), (4, 2, 1), taps)
+        assert fused_dma_3d_supported((4, 32, 32), (2, 1, 4), taps)
+    assert not fused_dma_3d_supported((4, 32, 32), (8, 1, 1), t7)  # slab
+    assert not fused_dma_3d_supported((4, 32, 32), (1, 2, 4), t7)  # x unsharded
+    assert not fused_dma_3d_supported((4, 32, 32), (1, 1, 1), t7)
+    assert not fused_dma_3d_supported((1, 32, 32), (2, 2, 2), t7)  # nx < 2
+    # the two scopes partition the x>=2 mesh space
+    for mesh in [(8, 1, 1), (2, 2, 2), (4, 2, 1), (2, 1, 4)]:
+        assert fused_dma_supported((4, 32, 32), mesh, t7) != (
+            fused_dma_3d_supported((4, 32, 32), mesh, t7)
+        )
+
+
+def test_fused_dma_3d_dispatch_gate(monkeypatch):
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        backend="auto",
+        halo="dma",
+        overlap=True,
+    )
+    assert _fused_dma_3d_fn(cfg) is not None
+    assert _fused_dma_fn(cfg) is None  # slab route stays out
+    import dataclasses
+
+    assert _fused_dma_3d_fn(
+        dataclasses.replace(cfg, stencil=StencilConfig(kind="27pt"))
+    ) is not None
+    for kw in (
+        dict(mesh=MeshConfig(shape=(8, 1, 1))),  # slab -> other route
+        dict(mesh=MeshConfig(shape=(1, 2, 4))),  # x unsharded
+        dict(halo="ppermute"),
+        dict(overlap=False),
+    ):
+        assert _fused_dma_3d_fn(dataclasses.replace(cfg, **kw)) is None
+
+
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+def test_fused_dma_3d_step_lowers_for_multichip_tpu(kind, monkeypatch):
+    """The full make_step_fn dispatch on the production (2,2,2) block mesh
+    — fused kernel + y/z face ppermutes seeded by the landed ghosts +
+    shell patches — lowers to Mosaic. The collective-permutes present must
+    be the y/z face exchanges only (the x transfer lives inside the custom
+    call)."""
+    monkeypatch.setenv("HEAT3D_DIRECT_FORCE", "1")
+    cfg = SolverConfig(
+        grid=GridConfig.cube(32),
+        stencil=StencilConfig(kind=kind, bc=BoundaryCondition.DIRICHLET,
+                              bc_value=1.5),
+        mesh=MeshConfig(shape=(2, 2, 2)),
+        backend="auto",
+        halo="dma",
+        overlap=True,
+    )
+    assert _fused_dma_3d_fn(cfg) is not None
+    am = abstract_mesh(cfg.mesh)
+    step = make_step_fn(cfg, am, with_residual=True)
+    txt = lower_for_mesh(
+        step, cfg.mesh, (cfg.grid.shape, jnp.float32, P("x", "y", "z"))
+    ).as_text()
+    assert "tpu_custom_call" in txt  # the Mosaic fused kernel
+    # exactly the 4 y/z face ppermutes (2 per sharded y/z axis) — a 5th+
+    # would mean a reintroduced x transfer outside the custom call;
+    # spelling varies by JAX pipeline ('_' vs '-'), as in lowering_report
+    import re
+
+    n_permutes = len(re.findall(r"\bcollective[_-]permute\b", txt))
+    assert n_permutes == 4, n_permutes
+    assert "all-reduce" in txt or "all_reduce" in txt  # residual psum
 
 
 def test_fused_dma_dispatch_gate(monkeypatch):
