@@ -380,6 +380,11 @@ def _best_committed_tpu_record(paths=None):
                     "dtype": r["dtype"],
                     "time_blocking": r.get("time_blocking", 1),
                 }
+                # measurement timestamp (rows carry "ts" since r5): an
+                # outage round's carried record then proves which live
+                # session it came from
+                if isinstance(r.get("ts"), str):
+                    cand["ts"] = r["ts"]
             except Exception:  # noqa: BLE001 - skip malformed rows
                 continue
             cur = best.get(dkey)

@@ -32,6 +32,15 @@ from heat3d_tpu.utils.timing import (
 )
 
 
+def _utc_now() -> str:
+    import datetime
+
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+    )
+
+
 def bench_throughput(
     cfg: SolverConfig,
     steps: int = 50,
@@ -83,6 +92,9 @@ def bench_throughput(
     direct = _resolved_direct(cfg)
     return {
         "bench": "throughput",
+        # measurement time (UTC): lets a later outage round's fallback
+        # prove WHICH session a carried committed row came from
+        "ts": _utc_now(),
         # platform provenance: bench_results.jsonl is the on-chip record
         # by convention, but only this field makes a stray CPU row
         # detectable (bench.py's fallback filters on it)
@@ -310,6 +322,7 @@ def bench_halo(
     bytes_per_dev = 2 * face_cells * jnp.dtype(cfg.precision.storage).itemsize
     return {
         "bench": "halo",
+        "ts": _utc_now(),
         "platform": jax.default_backend(),
         "grid": list(cfg.grid.shape),
         "mesh": list(cfg.mesh.shape),
