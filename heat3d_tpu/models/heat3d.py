@@ -102,11 +102,24 @@ class HeatSolver3D:
     def __init__(self, cfg: SolverConfig, devices=None):
         if cfg.halo == "dma":
             platform = jax.devices()[0].platform
-            if platform != "tpu":
+            # The fused DMA-overlap routes (overlap=True) have an off-TPU
+            # emulation tier: HEAT3D_DIRECT_INTERPRET dispatches their
+            # pure-XLA reference contracts (parallel/step._fused_dma_route
+            # — interpret mode cannot discharge remote DMA on the 3-axis
+            # mesh). The plain DMA exchange transport has no such tier.
+            # The SHARED env gate decides (backend/padding rules included)
+            # so this check cannot drift from the route dispatch.
+            from heat3d_tpu.parallel.step import _kernel_env_gate
+
+            gate_ok, gate_interpret = _kernel_env_gate(cfg)
+            emulated = cfg.overlap and gate_ok and gate_interpret
+            if platform != "tpu" and not emulated:
                 raise ValueError(
                     f"halo='dma' needs TPU hardware (Mosaic remote-DMA "
                     f"kernels); platform is {platform!r} — use "
-                    "halo='ppermute'"
+                    "halo='ppermute' (or set HEAT3D_DIRECT_INTERPRET=1 "
+                    "with --overlap for the fused routes' XLA reference "
+                    "emulation)"
                 )
         self.cfg = cfg
         self.mesh = build_mesh(cfg.mesh, devices)

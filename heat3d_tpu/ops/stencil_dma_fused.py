@@ -229,6 +229,26 @@ def reference_fused_step_xla(
     return out
 
 
+def reference_fused_superstep_xla(
+    u, taps, *, axis_name, axis_size, mesh_axes, periodic, bc_value,
+    compute_dtype=jnp.float32, out_dtype=None, interpret=True,
+):
+    """Pure-XLA reference for apply_superstep_fused_dma's RESULT contract:
+    two reference steps. The fused superstep is certified result-equal to
+    two plain steps on the 1D ring (tests/multidevice_checks.py —
+    including the mid's storage-dtype round trip, which two full steps
+    reproduce exactly), so the off-TPU emulation tier runs the
+    composition instead of the kernel."""
+    out_dtype = out_dtype or u.dtype
+    for _ in range(2):
+        u = reference_fused_step_xla(
+            u, taps, axis_name=axis_name, axis_size=axis_size,
+            mesh_axes=mesh_axes, periodic=periodic, bc_value=bc_value,
+            compute_dtype=compute_dtype, out_dtype=out_dtype,
+        )
+    return u
+
+
 def _rdma_halo(
     u_any, glo_ref, ghi_ref, send_sem, recv_sem, *, nx, width,
     axis_name, mesh_axes, axis_size, use_barrier,

@@ -457,13 +457,19 @@ def _fused_dma_route(cfg: SolverConfig, tb: int):
             apply_superstep_fused_dma,
             fused_dma2_supported,
             fused_dma_supported,
+            reference_fused_step_xla,
+            reference_fused_superstep_xla,
         )
     except ImportError:
         return None
-    supported, apply_fn = (
-        (fused_dma_supported, apply_step_fused_dma)
+    supported, apply_fn, reference_fn = (
+        (fused_dma_supported, apply_step_fused_dma, reference_fused_step_xla)
         if tb == 1
-        else (fused_dma2_supported, apply_superstep_fused_dma)
+        else (
+            fused_dma2_supported,
+            apply_superstep_fused_dma,
+            reference_fused_superstep_xla,
+        )
     )
     itemsize = jnp.dtype(cfg.precision.storage).itemsize
     if not supported(
@@ -475,10 +481,13 @@ def _fused_dma_route(cfg: SolverConfig, tb: int):
         jnp.dtype(cfg.precision.compute).itemsize,
     ):
         return None
-    import functools
-
     if interpret:
-        return functools.partial(apply_fn, interpret=True)
+        # Pallas' interpreter cannot discharge remote DMA on the
+        # production 3-named-axis meshes (jax 0.9) — the off-TPU
+        # emulation tier dispatches the kernels' pure-XLA reference
+        # contracts (certified equal on the 1D ring, where interpret CAN
+        # run the real kernels: tests/multidevice_checks.py)
+        return reference_fn
     return apply_fn
 
 
@@ -728,10 +737,11 @@ def make_step_fn(
     if cfg.overlap and direct is None:
         fused_dma = _fused_dma_fn(cfg)
         fused_dma_3d = None if fused_dma is not None else _fused_dma_3d_fn(cfg)
+        emulated = " [XLA reference emulation]" if _kernel_env_gate(cfg)[1] else ""
         if fused_dma is not None:
             _log_step_path_once(
                 "step path: fused DMA-overlap kernel (remote face copies "
-                "under the sweep)"
+                "under the sweep)" + emulated
             )
 
             def local_step(u_local, taps, cfg, compute_padded):
@@ -740,7 +750,7 @@ def make_step_fn(
         elif fused_dma_3d is not None:
             _log_step_path_once(
                 "step path: fused DMA-overlap kernel + y/z shell patches "
-                "(x-sharded block mesh)"
+                "(x-sharded block mesh)" + emulated
             )
 
             def local_step(u_local, taps, cfg, compute_padded):
@@ -812,6 +822,11 @@ def make_superstep_fn(
             _log_step_path_once(
                 "superstep path: fused DMA-overlap direct2 kernel "
                 "(width-2 slab RDMA under the sweep)"
+                + (
+                    " [XLA reference emulation]"
+                    if _kernel_env_gate(cfg)[1]
+                    else ""
+                )
             )
             taps2 = _solver_taps(cfg)
             spec2 = P(*cfg.mesh.axis_names)

@@ -148,9 +148,20 @@ def test_fused_dma_dispatch_gate(monkeypatch):
         halo="dma",
         overlap=True,
     )
-    assert _fused_dma_fn(cfg) is not None
-    # 27pt also dispatches (x-slab scope covers both stencil families)
+    # the interpret tier dispatches the pure-XLA reference contracts
+    # (remote DMA cannot be interpreted on the 3-axis mesh)
+    from heat3d_tpu.ops.stencil_dma_fused import (
+        reference_fused_step_xla,
+        reference_fused_superstep_xla,
+    )
+
     import dataclasses
+
+    assert _fused_dma_fn(cfg) is reference_fused_step_xla
+    assert _fused_dma2_fn(
+        dataclasses.replace(cfg, time_blocking=2)
+    ) is reference_fused_superstep_xla
+    # 27pt also dispatches (x-slab scope covers both stencil families)
 
     assert _fused_dma_fn(
         dataclasses.replace(cfg, stencil=StencilConfig(kind="27pt"))

@@ -171,6 +171,13 @@ def test_two_process_cli_golden_and_checkpoint(tmp_path):
         # boundaries: step and fused tb=2 superstep
         pytest.param([], True, id="faces-direct"),
         pytest.param(["--time-blocking", "2"], True, id="faces-direct-tb2"),
+        # the 3D fused-DMA route's glue (landed-ghost face seeding + y/z
+        # shell patches) across real process boundaries — dispatched via
+        # its XLA reference contract (interpret mode cannot RDMA on the
+        # 3-axis mesh; the glue and its collectives are the production
+        # code)
+        pytest.param(["--halo", "dma", "--overlap"], True,
+                     id="fused-dma-3d-emulated"),
     ],
 )
 def test_two_process_matches_single_process(extra, direct, monkeypatch, tmp_path):
@@ -188,9 +195,13 @@ def test_two_process_matches_single_process(extra, direct, monkeypatch, tmp_path
 
     env = _cpu_env(8)
     env.pop("HEAT3D_DIRECT_INTERPRET", None)  # baseline = exchange path
+    # the baseline oracle runs the ppermute exchange path: route-selection
+    # flags are stripped (schedule knobs like --time-blocking stay)
+    route_flags = {"--halo", "dma", "--overlap"}
+    baseline_extra = [a for a in extra if a not in route_flags]
     single = subprocess.run(
         [sys.executable, "-m", "heat3d_tpu", "--grid", "16", "--steps", "4",
-         "--mesh", "2", "2", "2", *extra],
+         "--mesh", "2", "2", "2", *baseline_extra],
         env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
     )
     assert single.returncode == 0, single.stderr
