@@ -50,19 +50,29 @@ def test_multidevice_checks_on_cpu_mesh():
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "ndev,mesh,kind,dtype",
+    "ndev,mesh,kind,dtype,fused",
     [
-        (64, (4, 4, 4), "27pt", "fp32"),   # judged config 4 topology
-        (128, (8, 4, 4), "7pt", "bf16"),   # judged config 5 topology
+        (64, (4, 4, 4), "27pt", "fp32", False),   # judged config 4 topology
+        (128, (8, 4, 4), "7pt", "bf16", False),   # judged config 5 topology
+        # the 3D fused-DMA route's glue at the judged topologies, via its
+        # XLA reference contract (interpret cannot RDMA on multi-axis
+        # meshes): landed-ghost face seeding + y/z shell patches execute
+        # over 64/128 real mesh devices
+        (64, (4, 4, 4), "27pt", "fp32", True),
+        (128, (8, 4, 4), "7pt", "bf16", True),
     ],
 )
-def test_judged_pod_topology_executes(ndev, mesh, kind, dtype):
+def test_judged_pod_topology_executes(ndev, mesh, kind, dtype, fused):
     """EXECUTE (not just lower) the judged pod decompositions: a full
     distributed step over 64/128 virtual CPU devices at tiny scale must
     match the same grid run undecomposed. Upgrades configs 4-5 from
     compile-only evidence (docs/LOWERING.md) to executed evidence —
-    bounded by host memory only because the blocks are tiny."""
+    bounded by host memory only because the blocks are tiny. ``fused``
+    arms dispatch the 3D fused-DMA route (reference-emulated) instead of
+    the default step."""
     env = _cpu_mesh_env(ndev)
+    if fused:
+        env["HEAT3D_DIRECT_INTERPRET"] = "1"
     code = f"""
 import jax, jax.numpy as jnp, numpy as np
 from heat3d_tpu.core.config import (BoundaryCondition, GridConfig,
@@ -70,6 +80,7 @@ from heat3d_tpu.core.config import (BoundaryCondition, GridConfig,
 from heat3d_tpu.parallel.step import make_step_fn
 from heat3d_tpu.parallel.topology import build_mesh, field_sharding
 
+fused = {fused!r}
 mesh_shape = {mesh!r}
 grid = tuple(4 * m for m in mesh_shape)
 prec = Precision.bf16() if {dtype!r} == "bf16" else Precision.fp32()
@@ -77,9 +88,15 @@ host = np.random.default_rng(0).standard_normal(grid).astype(np.float32)
 
 outs = {{}}
 for shape in (mesh_shape, (1, 1, 1)):
+    on_route = fused and shape != (1, 1, 1)
     cfg = SolverConfig(grid=GridConfig(shape=grid),
         stencil=StencilConfig(kind={kind!r}, bc=BoundaryCondition.PERIODIC),
-        mesh=MeshConfig(shape=shape), precision=prec, backend="jnp")
+        mesh=MeshConfig(shape=shape), precision=prec,
+        backend="auto" if on_route else "jnp",
+        halo="dma" if on_route else "ppermute", overlap=on_route)
+    if on_route:
+        from heat3d_tpu.parallel.step import _fused_dma_3d_fn
+        assert _fused_dma_3d_fn(cfg) is not None, "fused 3D route must dispatch"
     m = build_mesh(cfg.mesh, devices=jax.devices()[: cfg.mesh.num_devices])
     step = jax.jit(make_step_fn(cfg, m, with_residual=True))
     u = jax.device_put(jnp.asarray(host, jnp.dtype(prec.storage)),
@@ -89,8 +106,23 @@ for shape in (mesh_shape, (1, 1, 1)):
 
 got, r_got = outs[mesh_shape]
 want, r_want = outs[(1, 1, 1)]
-np.testing.assert_array_equal(got, want)  # same math, same op order
-np.testing.assert_allclose(r_got, r_want, rtol=1e-5)
+if fused:
+    # the fused route's ghost-stack assembly associates adds differently
+    # from the exchange path's padded concatenate before the one
+    # storage-dtype round-off: FMA-rounding at fp32; at bf16 a 1-ulp
+    # disagreement can be a relative difference up to 2^-7 low in a
+    # binade, so the bound must cover it at every magnitude (8e-3, the
+    # 2-ulp convention of the tb=2 ring check)
+    tol = 8e-3 if {dtype!r} == "bf16" else 1e-5
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+    # residual: a sum of squared per-element 1-ulp disagreements —
+    # tiered with the value tolerance, not the bitwise arms' bound
+    np.testing.assert_allclose(
+        r_got, r_want, rtol=1e-4 if {dtype!r} == "bf16" else 1e-5
+    )
+else:
+    np.testing.assert_array_equal(got, want)  # same math, same op order
+    np.testing.assert_allclose(r_got, r_want, rtol=1e-5)
 print(f"POD TOPOLOGY OK: {{mesh_shape}} over {ndev} devices == (1,1,1)")
 """
     proc = subprocess.run(
