@@ -18,12 +18,27 @@ echo "=== tpu_measure_all $(date -u +%FT%TZ) ===" | tee -a "$LOG"
 # stale pool claim (left by any client killed mid-claim) costs bounded
 # waiting, not a stage timeout burned inside backend init. See
 # heat3d_tpu/utils/backendprobe.py::wait_for_backend.
+# Anchor-then-short gating, shared by EVERY gate in this session (the
+# suite script implements the same rule with the same knob): the first
+# failure pays the full TPU_WAIT (the wait-for-heal anchor); while the
+# tunnel stays down, later gates wait only TPU_WAIT_SHORT (default
+# 300 s). Gates run back-to-back, so a heal is still detected within one
+# probe interval either way — short gates just cycle through dead
+# stages/arms faster, and the driver loop (measure_until_complete.sh)
+# retries what was skipped next attempt. A success re-arms the full
+# anchor: a NEW outage gets a new full wait.
+GATE_FAILED=""
 wait_tpu() {
-  python -m heat3d_tpu.utils.backendprobe \
-    --wait "${TPU_WAIT:-1800}" --interval "${TPU_WAIT_INTERVAL:-60}" \
-    >/dev/null 2>&1 \
-    || { echo "TPU unreachable past TPU_WAIT; skipping: $*" | tee -a "$LOG"
-         return 1; }
+  local w="${TPU_WAIT:-1800}"
+  [[ -n $GATE_FAILED ]] && w="${TPU_WAIT_SHORT:-300}"
+  if python -m heat3d_tpu.utils.backendprobe \
+      --wait "$w" --interval "${TPU_WAIT_INTERVAL:-60}" >/dev/null 2>&1; then
+    GATE_FAILED=""
+    return 0
+  fi
+  GATE_FAILED=1
+  echo "TPU unreachable past ${w}s; skipping: $*" | tee -a "$LOG"
+  return 1
 }
 # a TPU measurement session is meaningless off the axon env — fail fast
 # rather than waiting TPU_WAIT for a platform that can't appear
