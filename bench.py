@@ -72,7 +72,10 @@ def _probe_with_retry():
     sized to outlast a stale pool claim) are still capped by the shared
     deadline: probing stops early whenever the remaining budget wouldn't
     leave the CPU fallback its reserve, so the JSON line always lands
-    inside HEAT3D_BENCH_DEADLINE."""
+    inside HEAT3D_BENCH_DEADLINE. The loop itself is the shared
+    resilience.retry.RetryPolicy — the reserve gate rides in its
+    ``proceed`` hook, per-probe timeouts still shrink to the budget."""
+    from heat3d_tpu.resilience.retry import RetryPolicy
     from heat3d_tpu.utils.backendprobe import probe_platform, probe_timeout
 
     # Defaults sized for the axon pool's claim semantics (one client at a
@@ -83,27 +86,43 @@ def _probe_with_retry():
     # always gets its reserve.
     attempts = int(os.environ.get("HEAT3D_BENCH_PROBE_ATTEMPTS", "8"))
     backoff = float(os.environ.get("HEAT3D_BENCH_PROBE_BACKOFF", "60"))
-    for i in range(attempts):
-        # probes shrink to the shared deadline like rung timeouts do: a
-        # tight HEAT3D_BENCH_DEADLINE must not be eaten by probing before
-        # the CPU fallback has budget to print the line
-        budget = _remaining() - _CPU_FALLBACK_RESERVE
-        if budget < 30:
+    if attempts < 1:  # probe-less run: straight to the CPU fallback
+        return None
+    policy = RetryPolicy(
+        max_attempts=attempts,
+        base_delay_s=backoff,
+        multiplier=1.0,  # fixed cadence: claim expiry is time-, not count-based
+        max_delay_s=backoff,
+    )
+
+    def proceed():
+        if _remaining() - _CPU_FALLBACK_RESERVE < 30:
             sys.stderr.write(
                 "bench: deadline nearly exhausted during probing; "
                 "stopping probes for the CPU fallback\n"
             )
-            return None
-        platform = probe_platform(timeout=min(probe_timeout(), budget))
-        if platform is not None:
-            return platform
-        sys.stderr.write(
-            f"bench: backend probe {i + 1}/{attempts} failed"
-            + (f"; retrying in {backoff:.0f}s\n" if i + 1 < attempts else "\n")
-        )
-        if i + 1 < attempts:
-            time.sleep(backoff)
-    return None
+            return False
+        return True
+
+    def attempt():
+        # probes shrink to the shared deadline like rung timeouts do: a
+        # tight HEAT3D_BENCH_DEADLINE must not be eaten by probing before
+        # the CPU fallback has budget to print the line
+        budget = _remaining() - _CPU_FALLBACK_RESERVE
+        return probe_platform(timeout=min(probe_timeout(), max(budget, 30)))
+
+    def on_attempt(rec):
+        if not rec.ok:
+            sys.stderr.write(
+                f"bench: backend probe {rec.index + 1}/{attempts} failed"
+                + (f"; retrying in {rec.slept_s:.0f}s\n"
+                   if rec.slept_s else "\n")
+            )
+
+    if not proceed():  # the engine always runs attempt 1; gate it here
+        return None
+    outcome = policy.run(attempt, proceed=proceed, on_attempt=on_attempt)
+    return outcome.value if outcome.ok else None
 
 
 def _emit(rec) -> int:

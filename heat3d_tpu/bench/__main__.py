@@ -29,13 +29,18 @@ def main(argv=None) -> int:
     p.add_argument("--bench", choices=["all", "throughput", "halo"], default="all")
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--iters", type=int, default=30, help="halo timing iterations")
+    p.add_argument(
+        "--sweep-state", default=None, metavar="FILE",
+        help="journal landed rows here (resilience.sweepstate); an "
+        "interrupted --bench all sweep resumes at the first missing row",
+    )
     args = p.parse_args(argv)
     cfg = config_from_args(args)
 
-    profile_cm = None
-    if args.profile_dir:
-        profile_cm = jax.profiler.trace(args.profile_dir)
-        profile_cm.__enter__()
+    from heat3d_tpu.utils.timing import maybe_profile
+
+    profile_cm = maybe_profile(args.profile_dir)
+    profile_cm.__enter__()
     try:
         if args.bench == "throughput":
             import json
@@ -47,10 +52,9 @@ def main(argv=None) -> int:
 
             print(json.dumps(bench_halo(cfg, iters=args.iters)))
         else:
-            run_suite([cfg], steps=args.steps)
+            run_suite([cfg], steps=args.steps, state_path=args.sweep_state)
     finally:
-        if profile_cm is not None:
-            profile_cm.__exit__(None, None, None)
+        profile_cm.__exit__(None, None, None)
     return 0
 
 

@@ -23,6 +23,7 @@ from heat3d_tpu.core.config import SolverConfig
 from heat3d_tpu.models.heat3d import HeatSolver3D
 from heat3d_tpu.parallel.step import exchange
 from heat3d_tpu.parallel.topology import build_mesh, field_sharding
+from heat3d_tpu.utils.compat import shard_map
 from heat3d_tpu.utils.timing import (
     calibrate_trip_count,
     force_sync,
@@ -87,9 +88,17 @@ def bench_throughput(
     updates = cfg.grid.num_cells * steps
     gcells = updates / best / 1e9
     # one consistent evaluation of the env-dependent route/selector state
-    # for all three provenance fields (each walks the real dispatch)
+    # for all the provenance fields (each walks the real dispatch)
     mehrstellen = _mehrstellen_route(cfg)
     direct = _resolved_direct(cfg)
+    fused = _resolved_fused_dma(cfg)
+    from heat3d_tpu.parallel.step import _kernel_env_gate
+
+    # the fused route has an off-TPU emulation tier (the pure-XLA
+    # reference contracts under HEAT3D_DIRECT_INTERPRET): record it
+    # EXPLICITLY so A/B tooling cannot mistake an emulated row for a real
+    # Mosaic-kernel row without cross-checking the platform field
+    fused_emulated = bool(fused and _kernel_env_gate(cfg)[1])
     return {
         "bench": "throughput",
         # measurement time (UTC): lets a later outage round's fallback
@@ -131,7 +140,10 @@ def bench_throughput(
         # overlap+halo='dma' rows: whether the fused DMA-overlap kernel
         # (vs an error'd/jnp fallback elsewhere) actually resolved —
         # the pod A/B vs faces-direct needs the RESOLVED route on record
-        "fused_dma_path": _resolved_fused_dma(cfg),
+        "fused_dma_path": fused,
+        # ... and whether that resolution was the XLA reference EMULATION
+        # tier rather than the Mosaic kernel (ADVICE r5 item 2)
+        "fused_dma_emulated": fused_emulated,
     }
 
 
@@ -287,7 +299,7 @@ def bench_halo(
         return jax.lax.fori_loop(0, n, body, u_local)
 
     run_n = jax.jit(
-        jax.shard_map(
+        shard_map(
             _loop,
             mesh=mesh,
             in_specs=(spec, P()),
@@ -339,25 +351,83 @@ def bench_halo(
     }
 
 
-def run_suite(configs: List[SolverConfig], steps: int = 50, out=None) -> List[Dict]:
+def run_suite(
+    configs: List[SolverConfig],
+    steps: int = 50,
+    out=None,
+    state_path: Optional[str] = None,
+) -> List[Dict]:
     """Run throughput for each config + halo once per distinct exchange
     shape; emit one JSON line per result.
 
     The halo latency depends only on (grid, mesh, storage dtype, transport)
     — not on tb/backend/stencil — so configs differing only in those knobs
     share one halo row instead of re-measuring it (the duplicate-row noise
-    in the round-2 tables)."""
+    in the round-2 tables).
+
+    With ``state_path``, every landed row is journaled in a
+    :class:`~heat3d_tpu.resilience.sweepstate.SweepState` and an
+    interrupted sweep (SIGTERM, backend death) RESUMES AT THE FIRST
+    MISSING ROW on the next invocation — completed rows are re-emitted
+    from the journal, not re-measured. Fault hooks
+    (``HEAT3D_FAULTS=sigterm:row=K``) fire per row so the resume path is
+    testable on CPU."""
+    from heat3d_tpu.resilience.faults import FaultPlan
+    from heat3d_tpu.resilience.sweepstate import SweepState, row_key
+
+    import os
+
     out = out or sys.stdout
+    state = SweepState(state_path) if state_path else None
+    plan = FaultPlan.from_env()
+    # On an axon TPU session, only ON-CHIP rows may retire a journal
+    # entry: a silent jax CPU fallback still prints a row, and journaling
+    # it would freeze a CPU number into the A/B record forever (same rule
+    # as tpu_measure_all.sh's row_landed gate). Off the axon env (CPU
+    # smoke/test sweeps) every row journals.
+    want_platform = (
+        "tpu"
+        if os.environ.get("PALLAS_AXON_POOL_IPS")
+        and os.environ.get("JAX_PLATFORMS") != "cpu"
+        else None
+    )
     results = []
     halo_seen = set()
-    for cfg in configs:
-        r = bench_throughput(cfg, steps=steps)
+    row_index = 0
+
+    def one_row(key: str, measure) -> Dict:
+        nonlocal row_index
+        if state is not None:
+            done = state.record(key)
+            if done is not None and done.get("record") is not None:
+                r = done["record"]
+                results.append(r)
+                print(json.dumps(r), file=out, flush=True)
+                return r
+        plan.on_sweep_row(row_index)
+        row_index += 1
+        r = measure()
         results.append(r)
         print(json.dumps(r), file=out, flush=True)
+        if state is not None:
+            if want_platform is None or r.get("platform") == want_platform:
+                state.mark_done(key, r)
+            else:
+                print(
+                    f"suite: row {key} measured on "
+                    f"{r.get('platform')!r}, not {want_platform!r} — left "
+                    "pending for the next healthy window",
+                    file=sys.stderr,
+                )
+        return r
+
+    for cfg in configs:
+        one_row(
+            row_key(cfg, "throughput"),
+            lambda cfg=cfg: bench_throughput(cfg, steps=steps),
+        )
         halo_key = (cfg.grid.shape, cfg.mesh.shape, cfg.precision.storage, cfg.halo)
         if halo_key not in halo_seen:
             halo_seen.add(halo_key)
-            r = bench_halo(cfg)
-            results.append(r)
-            print(json.dumps(r), file=out, flush=True)
+            one_row(row_key(cfg, "halo"), lambda cfg=cfg: bench_halo(cfg))
     return results

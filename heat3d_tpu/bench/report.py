@@ -66,7 +66,12 @@ def _fmt_route(r: Dict) -> str:
     if "direct_path" not in r and "chain_ops" not in r:
         return "—"
     if r.get("fused_dma_path"):
-        transport = "fused-dma"  # RDMA issued inside the sweep kernel
+        # RDMA issued inside the sweep kernel; "(emu)" marks rows that ran
+        # the XLA reference contract, not the Mosaic kernel — never let an
+        # emulated row read as a real fused-kernel number
+        transport = (
+            "fused-dma(emu)" if r.get("fused_dma_emulated") else "fused-dma"
+        )
     elif r.get("direct_path"):
         transport = "direct"
     else:

@@ -35,7 +35,7 @@ from heat3d_tpu.core.config import (
 )
 from heat3d_tpu.parallel import distributed
 from heat3d_tpu.utils.logging import emit_json, get_logger
-from heat3d_tpu.utils.timing import force_sync
+from heat3d_tpu.utils.timing import force_sync, maybe_profile
 
 log = get_logger("heat3d.cli")
 
@@ -106,6 +106,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None, help="checkpoint directory")
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true", help="resume from --checkpoint")
+    p.add_argument(
+        "--supervise", action="store_true",
+        help="run under the resilience supervisor: checkpoint generations "
+        "every --checkpoint-every steps into --checkpoint, watchdog the "
+        "backend, auto-resume from the last good generation (quarantining "
+        "corrupt ones). --steps is then the TARGET GLOBAL step: relaunching "
+        "the same command after a kill finishes the run. See "
+        "docs/RESILIENCE.md",
+    )
+    p.add_argument(
+        "--watchdog", type=float, default=None, metavar="SECONDS",
+        help="(with --supervise) per-chunk wall-clock budget; an overrun "
+        "triggers a backend probe and, if it fails, checkpoint-resume "
+        "recovery",
+    )
+    p.add_argument(
+        "--max-recoveries", type=int, default=3,
+        help="(with --supervise) give up after this many survived failures",
+    )
     p.add_argument("--profile-dir", default=None,
                    help="emit a jax.profiler trace (TensorBoard/Perfetto) here")
     p.add_argument("--coordinator", default=None, help="multi-host coordinator addr:port")
@@ -240,6 +259,9 @@ def _main(argv: Optional[List[str]] = None) -> int:
         )
     solver = HeatSolver3D(cfg)
 
+    if args.supervise:
+        return _main_supervised(args, cfg, solver, dump_slice)
+
     start_step = 0
     if args.resume and args.checkpoint:
         u, start_step = solver.load_checkpoint(args.checkpoint)
@@ -247,11 +269,31 @@ def _main(argv: Optional[List[str]] = None) -> int:
     else:
         u = solver.init_state(args.init)
 
-    profile_cm = None
-    if cfg.run.profile_dir:
-        profile_cm = jax.profiler.trace(cfg.run.profile_dir)
-        profile_cm.__enter__()
+    profile_cm = maybe_profile(cfg.run.profile_dir)
+    profile_cm.__enter__()
+    try:
+        u, elapsed, steps_done, residual = _timed_run(
+            args, cfg, solver, u, start_step
+        )
+    finally:
+        # exception-safe: a failed run must still close (and flush) the
+        # profiler trace instead of losing it; the bracket covers exactly
+        # warmup + the timed loop, as before (checkpoint/report IO stays
+        # out of the trace)
+        profile_cm.__exit__(None, None, None)
 
+    if args.checkpoint:
+        solver.save_checkpoint(args.checkpoint, u, steps_done)
+
+    return _finish(
+        args, cfg, solver, u, elapsed, steps_done, start_step, residual,
+        dump_slice,
+    )
+
+
+def _timed_run(args, cfg, solver, u, start_step):
+    """Warmup + the timed stepping loop; returns
+    ``(u, elapsed, steps_done, residual)``."""
     # Warm up the executables this mode will use, outside the timed window
     # (SURVEY.md §3.5: warmup iterations excluded). The dummy field is built
     # per-shard (zeros callback) so no process ever materializes the full
@@ -315,14 +357,117 @@ def _main(argv: Optional[List[str]] = None) -> int:
                 solver.save_checkpoint(args.checkpoint, u, start_step + done)
     force_sync(u)
     elapsed = time.perf_counter() - t0
-    steps_done = start_step + done
+    return u, elapsed, start_step + done, residual
 
-    if profile_cm is not None:
-        profile_cm.__exit__(None, None, None)
 
-    if args.checkpoint:
-        solver.save_checkpoint(args.checkpoint, u, steps_done)
+def _main_supervised(args, cfg, solver, dump_slice) -> int:
+    """The --supervise path: the supervisor owns init/resume, checkpoint
+    cadence, and recovery; this wrapper owns arg plumbing + reporting."""
+    if not args.checkpoint:
+        raise ValueError("--supervise requires --checkpoint DIR")
+    import os
 
+    from heat3d_tpu.resilience.supervisor import generation_dirs
+    from heat3d_tpu.utils import checkpoint as ckpt
+
+    if os.path.exists(
+        os.path.join(args.checkpoint, ckpt.MANIFEST)
+    ) and not generation_dirs(args.checkpoint):
+        # a plain (flat) checkpoint lives here; the supervisor only scans
+        # gen-* generations, so proceeding would silently restart at step
+        # 0 and orphan the user's progress
+        raise ValueError(
+            f"--checkpoint {args.checkpoint} holds a plain checkpoint, "
+            "not supervised generations — finish it with --resume "
+            "(without --supervise), or point --supervise at a fresh "
+            "directory"
+        )
+    if args.resume:
+        log.info(
+            "--resume is implied by --supervise (auto-resumes from the "
+            "newest good generation)"
+        )
+    if jax.process_count() > 1:
+        # single-controller only (supervisor.py docstring): per-process
+        # supervisors would race quarantine renames and generation prunes,
+        # and desynchronize the collective step loop on recovery
+        raise ValueError(
+            "--supervise is single-controller: multi-host launches must "
+            "supervise from the launcher (relaunch-on-exit resumes from "
+            "the shared generations) — drop --supervise here"
+        )
+    if cfg.run.tolerance is not None:
+        raise ValueError(
+            "--supervise drives the fixed-step loop; convergence mode "
+            "(--tol) is not supervised yet — drop one of the two flags"
+        )
+    if cfg.run.residual_every:
+        # don't silently eat a flag the plain loop honors: supervised
+        # chunks land on checkpoint boundaries only; the run still
+        # reports its final residual
+        log.warning(
+            "--residual-every is not supported under --supervise yet; "
+            "only the final residual is reported"
+        )
+    if not args.checkpoint_every:
+        # legal (auto-resume + final-checkpoint quarantine still work),
+        # but the whole run is then ONE chunk: a mid-run kill restarts
+        # from step 0 and any --watchdog budget covers the full run
+        log.warning(
+            "--supervise without --checkpoint-every K writes no mid-run "
+            "generations: an outage loses the whole run, not K steps"
+        )
+    t0 = time.perf_counter()
+    with maybe_profile(cfg.run.profile_dir):
+        result = solver.run_supervised(
+            total_steps=cfg.run.num_steps,
+            ckpt_root=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            watchdog_s=args.watchdog,
+            max_recoveries=args.max_recoveries,
+            init=args.init,
+            # the platform this run STARTED on: without it, a probe child
+            # whose jax silently falls back to CPU would classify a real
+            # TPU outage as "backend alive" (re-raise instead of recover)
+            # and a heal-wait would accept CPU instantly. In-process
+            # recovery stays same-platform; the TPU->CPU cross-mesh
+            # resume is the RELAUNCH path (generations on disk).
+            want_platform=jax.default_backend(),
+        )
+    elapsed = time.perf_counter() - t0
+    # Honest timing: heal waits are SLEEP, not work — leave them out of
+    # the throughput denominator (each recovery's wait is itemized in the
+    # supervised record). What remains still includes compile and any
+    # redone steps: supervised runs are a resilience surface, not a
+    # benchmark — the flag below keeps the number from being mistaken
+    # for a calibrated bench row downstream.
+    heal_s = sum(r.heal_wait_s for r in result.recoveries)
+    busy = max(elapsed - heal_s, 1e-9)
+    if result.residual is not None:
+        log.info(
+            "step %d residual %.6e", result.steps_done, result.residual
+        )
+    supervised_record = result.to_record()
+    supervised_record["timing_note"] = (
+        "seconds excludes heal waits but includes compile and redone "
+        "steps; not comparable to bench rows"
+    )
+    # report through the solver that PRODUCED u: a recovery may have
+    # rebuilt it (cross-mesh heal), and gather/slice on the stale
+    # instance would bind the dead mesh
+    return _finish(
+        args, cfg, result.solver or solver, result.u, busy,
+        result.steps_done, result.start_step, result.residual, dump_slice,
+        extra_summary={"supervised": supervised_record},
+    )
+
+
+def _finish(
+    args, cfg, solver, u, elapsed, steps_done, start_step, residual,
+    dump_slice, extra_summary=None,
+) -> int:
+    """Post-run reporting shared by the plain and supervised paths:
+    dumps, throughput summary, golden check, coordinator JSON."""
     slice_path = None
     if dump_slice is not None:
         axis, index, slice_path = dump_slice
@@ -355,6 +500,9 @@ def _main(argv: Optional[List[str]] = None) -> int:
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "backend": cfg.backend,
+        # platform provenance (same contract as bench rows): a CPU-fallback
+        # line must be distinguishable from an on-chip one downstream
+        "platform": jax.default_backend(),
         "steps": steps_done - start_step,
         "seconds": elapsed,
         "residual_l2": residual,
@@ -365,6 +513,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
         summary["slice_path"] = slice_path
     if vtk_path is not None:
         summary["vtk_path"] = vtk_path
+    if extra_summary:
+        summary.update(extra_summary)
 
     if args.golden_check:
         from heat3d_tpu.core import golden

@@ -26,6 +26,7 @@ from heat3d_tpu.parallel.step import (
 )
 from heat3d_tpu.parallel.topology import build_mesh, field_sharding
 from heat3d_tpu.utils import checkpoint as ckpt
+from heat3d_tpu.utils.compat import shard_map
 from heat3d_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -97,6 +98,13 @@ class HeatSolver3D:
         solver = HeatSolver3D(cfg)
         u = solver.init_state("hot-cube")
         u = solver.run(u, num_steps=100)
+
+    Construction-time vs step-build-time checks: the constructor validates
+    only platform/emulation availability for ``halo='dma'``; the fused
+    DMA routes' SCOPE gates (x-sharded mesh, unpadded shards, local-extent
+    minima) are enforced at step-build time inside
+    ``parallel.step.make_step_fn`` — an out-of-scope config constructs
+    fine and raises its precise ValueError when the step is built.
     """
 
     def __init__(self, cfg: SolverConfig, devices=None):
@@ -293,6 +301,32 @@ class HeatSolver3D:
         u, steps, res = self._converge(u, jnp.int32(max_steps), jnp.float32(tol))
         return RunResult(u=u, steps=int(steps), residual=float(res))
 
+    def run_supervised(
+        self,
+        total_steps: int,
+        ckpt_root: str,
+        checkpoint_every: int = 0,
+        **kwargs,
+    ):
+        """Run to global step ``total_steps`` under the resilience
+        supervisor: checkpoint generations every ``checkpoint_every``
+        steps into ``ckpt_root``, auto-resume from the newest good
+        generation (quarantining corrupt ones), survive backend
+        loss/hang by waiting for heal and resuming. ``total_steps`` is
+        the TARGET GLOBAL step — a resumed run finishes the original
+        run, it does not append to it. See
+        :func:`heat3d_tpu.resilience.supervisor.run_supervised` for the
+        knobs; by default a recovery rebuilds a fresh solver for this
+        config (re-resolving devices, so a TPU->CPU heal cross-mesh
+        stitch-resumes through ``utils.checkpoint``'s block stitching).
+        """
+        from heat3d_tpu.resilience.supervisor import run_supervised
+
+        kwargs.setdefault("make_solver", lambda: HeatSolver3D(self.cfg))
+        return run_supervised(
+            self, total_steps, ckpt_root, checkpoint_every, **kwargs
+        )
+
     # ---- IO --------------------------------------------------------------
 
     def gather(self, u: jax.Array) -> np.ndarray:
@@ -350,7 +384,7 @@ class HeatSolver3D:
 
         out_names = tuple(n for a, n in enumerate(names) if a != axis)
         plane = jax.jit(
-            jax.shard_map(
+            shard_map(
                 local_plane,
                 mesh=self.mesh,
                 in_specs=PartitionSpec(*names),
@@ -368,6 +402,34 @@ class HeatSolver3D:
 
     def load_checkpoint(self, path: str) -> Tuple[jax.Array, int]:
         u, step, _ = ckpt.load(path, self.sharding)
+        if tuple(u.shape) != self.cfg.padded_shape:
+            # fail loudly: silently stepping a wrong-shape field would
+            # finish "successfully" with metrics computed from the
+            # CONFIGURED grid — an inflated/garbage summary with clean
+            # provenance (and the supervised auto-resume path reaches
+            # here without any --resume flag). Distinguish the two causes:
+            # the same grid saved under a DIFFERENT mesh's bc-padding is a
+            # known cross-mesh limitation, not a wrong checkpoint.
+            # padding only ever rounds the grid UP, so saved >= grid on
+            # every dim is consistent with "same grid, other mesh"; any
+            # smaller dim proves a different grid outright
+            same_grid_other_padding = all(
+                s >= g for s, g in zip(u.shape, self.cfg.grid.shape)
+            )
+            hint = (
+                "the checkpoint was padded for a different mesh "
+                "(cross-mesh resume across bc-paddings is unsupported — "
+                "use a grid divisible by both meshes, or consolidate and "
+                "re-grid)"
+                if same_grid_other_padding
+                else "wrong checkpoint for this run"
+            )
+            raise ValueError(
+                f"checkpoint {path} holds a {tuple(u.shape)} field but "
+                f"this config's storage shape is {self.cfg.padded_shape} "
+                f"(grid {self.cfg.grid.shape} on mesh {self.cfg.mesh.shape})"
+                f" — {hint}"
+            )
         if u.dtype != self.storage_dtype:
             u = u.astype(self.storage_dtype)
         return u, step

@@ -28,6 +28,7 @@ from heat3d_tpu.core.config import (
 from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
 from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
 from heat3d_tpu.parallel.halo import exchange_halo
+from heat3d_tpu.utils.compat import shard_map
 
 # Local compute on a ghost-padded block: (up, taps, compute_dtype, out_dtype) -> interior
 LocalCompute = Callable[..., jax.Array]
@@ -791,14 +792,14 @@ def make_step_fn(
             r = lax.psum(r, axes)  # MPI_Allreduce analogue (SURVEY.md §3.3)
             return u_new, r
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh, in_specs=spec, out_specs=(spec, P()), check_vma=False
         )
 
     def local(u_local):
         return local_step(u_local, taps, cfg, compute_padded)
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
     )
 
@@ -834,7 +835,7 @@ def make_superstep_fn(
             def local_fused2(u_local):
                 return _local_step_fused_dma(u_local, taps2, cfg, fused2)
 
-            return jax.shard_map(
+            return shard_map(
                 local_fused2, mesh=mesh, in_specs=spec2, out_specs=spec2,
                 check_vma=False,
             )
@@ -887,7 +888,7 @@ def make_superstep_fn(
                         u_local, taps, cfg, direct2
                     )
 
-            return jax.shard_map(
+            return shard_map(
                 local2, mesh=mesh, in_specs=spec, out_specs=spec,
                 check_vma=False,
             )
@@ -936,7 +937,7 @@ def make_superstep_fn(
         def local(u_local):
             return _local_stepk(u_local, taps, cfg, compute_padded)
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
     )
 

@@ -17,6 +17,7 @@ import numpy as np
 from jax.sharding import AbstractMesh, Mesh, NamedSharding, PartitionSpec
 
 from heat3d_tpu.core.config import MeshConfig
+from heat3d_tpu.utils.compat import make_abstract_mesh
 
 
 def build_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
@@ -45,7 +46,7 @@ def abstract_mesh(cfg: MeshConfig) -> AbstractMesh:
     """Device-free mesh for compile-only lowering of multi-chip programs —
     how multi-chip paths are validated on a single-chip dev box
     (SURVEY.md §4 'Distributed-without-cluster', §7.0)."""
-    return AbstractMesh(cfg.shape, cfg.axis_names)
+    return make_abstract_mesh(cfg.shape, cfg.axis_names)
 
 
 def lower_for_mesh(fn, cfg: MeshConfig, *avals, platform: str = "tpu"):

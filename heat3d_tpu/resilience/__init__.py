@@ -1,0 +1,46 @@
+"""Resilience subsystem: survive the environment, test the failure paths.
+
+Five rounds of history say the dominant failure mode is not the solver but
+the backend: tunnel outages killed measurement stages and put CPU fallbacks
+into graded artifacts. The defenses used to live scattered across
+``utils/backendprobe.py``, ``bench.py``, and shell sleep loops — divergent,
+duplicated, and untestable without a real outage. This package unifies them:
+
+- ``retry``      — the ONE retry/backoff implementation (jittered
+                   exponential backoff, deadline budgets, structured
+                   outcome records). ``backendprobe.wait_for_backend``,
+                   ``bench.py``'s probe loop, and the measurement scripts'
+                   pacing all route through it.
+- ``faults``     — deterministic fault injection (backend-loss-at-step-N,
+                   hang-until-deadline, SIGTERM-mid-sweep, corrupted
+                   checkpoint shard) so every retry/resume path runs under
+                   pytest on CPU.
+- ``supervisor`` — the supervised run loop: checkpoint every K steps into
+                   checksummed generations, watchdog the backend, quarantine
+                   corrupt generations, resume from the last good one when
+                   the backend heals (including cross-mesh stitch-resume).
+- ``sweepstate`` — per-row sweep state so an interrupted A/B measurement
+                   session resumes at the first missing row.
+
+See docs/RESILIENCE.md for the operator-facing protocol.
+"""
+
+from heat3d_tpu.resilience.retry import RetryOutcome, RetryPolicy
+from heat3d_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedBackendLoss,
+    InjectedFault,
+)
+from heat3d_tpu.resilience.sweepstate import SweepState
+from heat3d_tpu.resilience.supervisor import SupervisedResult, run_supervised
+
+__all__ = [
+    "FaultPlan",
+    "InjectedBackendLoss",
+    "InjectedFault",
+    "RetryOutcome",
+    "RetryPolicy",
+    "SupervisedResult",
+    "SweepState",
+    "run_supervised",
+]

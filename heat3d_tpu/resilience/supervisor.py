@@ -1,0 +1,450 @@
+"""The supervised run loop: checkpoint-gated, watchdogged, self-resuming.
+
+``utils/checkpoint.py`` has long supported sharded save/restore and
+cross-mesh stitch-resume — but nothing *drove* it automatically: a run
+killed at step N was a dead run, and a backend outage mid-run lost
+everything since step 0. This module is the driver:
+
+- **Checkpoint every K steps** into *generations* —
+  ``<root>/gen-<step>/`` directories, each a complete checksummed
+  checkpoint. The newest ``keep_generations`` are retained; the rest
+  pruned after each successful save.
+- **Detect backend death** (exceptions out of the compiled step, injected
+  faults) and **suspect hangs** (a chunk overrunning the watchdog budget)
+  — then confirm with the bounded out-of-process probes
+  (``utils/backendprobe``; a killable child, never an in-process
+  ``jax.devices()`` that can wedge forever).
+- **Wait for the backend to heal** through the one
+  :class:`~heat3d_tpu.resilience.retry.RetryPolicy` implementation, then
+  **rebuild the solver and resume from the last good generation**. A
+  corrupt generation (checksum mismatch, torn manifest) is quarantined
+  and the previous generation is loaded instead. Because
+  ``checkpoint.load`` stitches across meshes, the rebuilt solver may
+  legitimately land on different hardware (TPU -> CPU cross-mesh
+  stitch-resume) — the resume path is the same either way.
+
+Hang honesty: an in-process supervisor can only *classify* a chunk that
+eventually returns (or a fault that raises). A chunk truly stuck inside a
+non-returning C call never comes back to Python — that tier of protection
+stays with the process-level guards (coreutils ``timeout`` + the SIGTERM
+-> SystemExit claim-release installed by every entry point), and a
+SIGTERM'd supervised run resumes from its last generation on relaunch.
+
+Scope: single-controller supervision. On multi-host launches the
+quarantine rename and generation prune would race across processes, and
+a process that merely cannot SEE its peers' shards must not condemn a
+generation — coordinate supervision from the launcher (one supervisor,
+per-host workers) before lifting this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from heat3d_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedBackendLoss,
+    InjectedFault,
+    InjectedHang,
+)
+from heat3d_tpu.resilience.retry import RetryPolicy
+from heat3d_tpu.utils import checkpoint as ckpt
+from heat3d_tpu.utils.logging import get_logger
+
+log = get_logger("heat3d.supervisor")
+
+GEN_PREFIX = "gen-"
+
+# Heal-wait default: the same shape as the measurement scripts' gate
+# (probe every 60 s, 1.5x backoff capped at 5 min — every probe is a claim
+# attempt, see backendprobe), bounded at 30 min like TPU_WAIT.
+DEFAULT_HEAL_POLICY = RetryPolicy(
+    base_delay_s=60.0,
+    multiplier=1.5,
+    max_delay_s=300.0,
+    jitter_frac=0.1,
+    deadline_s=1800.0,
+)
+
+
+class BackendSuspect(RuntimeError):
+    """A chunk overran the watchdog and the follow-up probe found the
+    backend unreachable."""
+
+
+@dataclasses.dataclass
+class Recovery:
+    """One survived failure, as a structured record for the run summary."""
+
+    step: int
+    kind: str  # 'backend-loss' | 'hang' | 'error'
+    error: str
+    heal_wait_s: float
+    heal_attempts: int
+    resumed_from: Optional[int]
+    quarantined: List[str] = dataclasses.field(default_factory=list)
+
+    def to_record(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SupervisedResult:
+    u: object
+    steps_done: int
+    start_step: int
+    resumed_from: Optional[int]
+    residual: Optional[float]
+    checkpoints_written: int
+    recoveries: List[Recovery]
+    # the solver that produced u — NOT necessarily the one passed in: a
+    # recovery rebuilds it (possibly on different hardware/mesh), and any
+    # post-run operation on u (gather, slice dump, golden check) must use
+    # this one, not the caller's stale instance
+    solver: object = None
+
+    def to_record(self) -> dict:
+        return {
+            "steps_done": self.steps_done,
+            "start_step": self.start_step,
+            "resumed_from": self.resumed_from,
+            "checkpoints_written": self.checkpoints_written,
+            "recoveries": [r.to_record() for r in self.recoveries],
+        }
+
+
+# ---- generation bookkeeping ---------------------------------------------
+
+
+def generation_dirs(root: str) -> List[Tuple[int, str]]:
+    """(step, path) for every generation under ``root``, oldest first.
+    Quarantined directories are invisible by construction (their names no
+    longer parse)."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith(GEN_PREFIX):
+            continue
+        try:
+            step = int(name[len(GEN_PREFIX):])
+        except ValueError:
+            continue
+        out.append((step, os.path.join(root, name)))
+    out.sort()
+    return out
+
+
+def save_generation(solver, u, step: int, root: str, keep: int = 2) -> str:
+    """Write ``<root>/gen-<step>`` and prune to the newest ``keep``.
+
+    The prune happens only AFTER the new generation's manifest landed, so
+    a crash mid-save can orphan at most one partial directory — which the
+    load path then quarantines (no manifest) and skips."""
+    gen = os.path.join(root, f"{GEN_PREFIX}{step:08d}")
+    solver.save_checkpoint(gen, u, step)
+    gens = generation_dirs(root)
+    for _, old in gens[:-keep] if keep > 0 else []:
+        if os.path.realpath(old) == os.path.realpath(gen):
+            continue
+        import shutil
+
+        shutil.rmtree(old, ignore_errors=True)
+    return gen
+
+
+def load_latest_generation(solver, root: str):
+    """Restore from the newest loadable generation.
+
+    Walks generations newest-first; a generation that fails to load —
+    checksum mismatch (:class:`~heat3d_tpu.utils.checkpoint.ShardCorruptError`),
+    torn manifest, missing shards — is QUARANTINED (renamed out of the
+    scan) and the previous one is tried. Returns
+    ``((u, step) | None, quarantined_paths)`` — the quarantine list is
+    returned even when NOTHING loads, so an every-generation-corrupt
+    recovery still gets a truthful post-mortem record.
+    """
+    quarantined: List[str] = []
+    for step, gen in reversed(generation_dirs(root)):
+        # Quarantine only on PROVEN damage: a checksum mismatch, or a
+        # missing/torn manifest (a save that died mid-write). Any other
+        # load failure — shard files not visible from this process, a
+        # stale different-grid file, a config mismatch — may be the
+        # ENVIRONMENT's or the CONFIG's fault, and renaming the
+        # generation would destroy a resume some other context could
+        # still perform; those are skipped in place.
+        try:
+            ckpt.load_manifest(gen)
+        except FileNotFoundError as e:  # save died before its manifest
+            log.warning("generation %s has no manifest (%s); quarantining",
+                        gen, e)
+            quarantined.append(ckpt.quarantine(gen, reason=str(e)))
+            continue
+        except ValueError as e:  # torn/truncated JSON: proven damage
+            log.warning("generation %s manifest is torn (%s); quarantining",
+                        gen, e)
+            quarantined.append(ckpt.quarantine(gen, reason=str(e)))
+            continue
+        except OSError as e:
+            # EIO/ESTALE/EACCES on a flaky FS is the ENVIRONMENT's fault,
+            # not proven damage — skip in place, never rename away a
+            # generation that may read fine next attempt
+            log.warning(
+                "generation %s manifest unreadable here (%s); skipping "
+                "WITHOUT quarantine", gen, e,
+            )
+            continue
+        try:
+            u, got_step = solver.load_checkpoint(gen)
+            return (u, got_step), quarantined
+        except ckpt.ShardCorruptError as e:
+            log.warning("generation %s corrupt (%s); quarantining", gen, e)
+            quarantined.append(ckpt.quarantine(gen, reason=str(e)))
+        except (OSError, ValueError, KeyError) as e:
+            log.warning(
+                "generation %s unloadable here (%s: %s); skipping WITHOUT "
+                "quarantine — not proven corrupt (check shard visibility "
+                "and that --grid/--mesh match the checkpoint)",
+                gen, type(e).__name__, e,
+            )
+    return None, quarantined
+
+
+# ---- the supervised loop -------------------------------------------------
+
+
+def _default_probe(want: Optional[str]) -> Optional[str]:
+    from heat3d_tpu.utils.backendprobe import probe_platform
+
+    p = probe_platform()
+    if p is None or (want is not None and p != want):
+        return None
+    return p
+
+
+def _wait_for_heal(
+    policy: RetryPolicy,
+    plan: FaultPlan,
+    want: Optional[str],
+    probe: Optional[Callable[[], Optional[str]]],
+):
+    """Probe (fault-overridable) under the retry policy until the backend
+    answers. Returns the RetryOutcome; ``outcome.ok`` False = never healed."""
+
+    def attempt():
+        override = plan.probe_override()
+        if override == "down":
+            return None
+        if probe is not None:
+            return probe()
+        return _default_probe(want)
+
+    return policy.run(attempt)
+
+
+def run_supervised(
+    solver,
+    total_steps: int,
+    ckpt_root: str,
+    checkpoint_every: int = 0,
+    *,
+    make_solver: Optional[Callable[[], object]] = None,
+    heal_policy: Optional[RetryPolicy] = None,
+    watchdog_s: Optional[float] = None,
+    max_recoveries: int = 3,
+    keep_generations: int = 2,
+    want_platform: Optional[str] = None,
+    probe: Optional[Callable[[], Optional[str]]] = None,
+    faults: Optional[FaultPlan] = None,
+    init: str = "hot-cube",
+    finish_with_residual: bool = True,
+) -> SupervisedResult:
+    """Run ``solver`` to global step ``total_steps`` under supervision.
+
+    ``total_steps`` is the TARGET GLOBAL STEP, not a relative count: a
+    fresh run advances 0 -> total, a resumed run advances from its newest
+    generation's step — so re-launching the same command after a kill
+    finishes the run instead of running past it (the property the
+    interrupted-equals-uninterrupted tests assert, bit-for-bit on the
+    same mesh).
+
+    ``make_solver`` rebuilds the solver after a backend loss (default:
+    reuse ``solver`` — correct when the process and its backend survived,
+    as with injected faults; a real cross-backend recovery passes a
+    factory that re-resolves devices). ``probe`` overrides the heal probe
+    (tests); ``faults`` overrides the env-parsed
+    :class:`~heat3d_tpu.resilience.faults.FaultPlan`.
+    """
+    from heat3d_tpu.utils.timing import force_sync
+
+    plan = faults if faults is not None else FaultPlan.from_env()
+    policy = heal_policy or DEFAULT_HEAL_POLICY
+    recoveries: List[Recovery] = []
+    checkpoints = 0
+    resumed_from = None
+
+    os.makedirs(ckpt_root, exist_ok=True)
+    loaded, quarantined = load_latest_generation(solver, ckpt_root)
+    if quarantined:
+        log.warning(
+            "resume quarantined %d generation(s): %s",
+            len(quarantined), quarantined,
+        )
+    if loaded is not None:
+        u, done = loaded
+        resumed_from = done
+        log.info("supervised run resuming at step %d from %s", done, ckpt_root)
+    else:
+        if generation_dirs(ckpt_root):
+            # generations survive on disk but none loaded HERE (skipped
+            # without quarantine: FS blip, config mismatch): restarting
+            # at step 0 would silently orphan real progress — refuse, the
+            # same rule the CLI applies to flat checkpoints
+            raise ValueError(
+                f"{ckpt_root} holds generations but none is loadable from "
+                "this process/config (see warnings above) — fix the "
+                "mismatch or point the run at a fresh directory; refusing "
+                "to restart at step 0 over existing progress"
+            )
+        u, done = solver.init_state(init), 0
+    start_step = done
+    if done > total_steps:
+        raise ValueError(
+            f"checkpoint at step {done} is past the target {total_steps} — "
+            "refusing to run backwards (raise --steps or point --checkpoint "
+            "at a fresh directory)"
+        )
+
+    residual = None
+    while done < total_steps:
+        # next boundary: a checkpoint point or the end
+        if checkpoint_every > 0:
+            nxt = min(
+                (done // checkpoint_every + 1) * checkpoint_every, total_steps
+            )
+        else:
+            nxt = total_steps
+        n = nxt - done
+        try:
+            plan.on_step(done, watchdog_s=watchdog_s)
+            t0 = time.monotonic()
+            if nxt == total_steps and finish_with_residual:
+                if n > 1:
+                    u = solver.run(u, n - 1)
+                u, r2 = solver.step_with_residual(u)
+                import numpy as np
+
+                residual = float(np.sqrt(np.float64(r2)))
+            else:
+                u = solver.run(u, n)
+            force_sync(u)
+            chunk_s = time.monotonic() - t0
+            if watchdog_s is not None and chunk_s > watchdog_s:
+                # the chunk RETURNED but blew its budget: a wedging tunnel
+                # slow-walks before it stops answering. Probe before
+                # trusting the result.
+                log.warning(
+                    "chunk %d->%d took %.1fs (watchdog %.1fs); probing",
+                    done, nxt, chunk_s, watchdog_s,
+                )
+                if (probe() if probe is not None
+                        else _default_probe(want_platform)) is None:
+                    raise BackendSuspect(
+                        f"chunk overran watchdog ({chunk_s:.1f}s > "
+                        f"{watchdog_s:.1f}s) and the backend probe failed"
+                    )
+            # the save sits INSIDE the recovery envelope: checkpoint.save
+            # reads shard data off the device, and a backend dying exactly
+            # at a chunk boundary (a developing outage's likeliest moment)
+            # must trigger heal-and-resume like any other loss, not escape
+            # the supervisor uncaught
+            gen = save_generation(
+                solver, u, nxt, ckpt_root, keep=keep_generations
+            )
+            checkpoints += 1
+            plan.on_checkpoint_saved(gen)
+        except (InjectedBackendLoss, InjectedHang, BackendSuspect,
+                RuntimeError) as e:
+            if isinstance(e, InjectedFault):
+                kind = "hang" if isinstance(e, InjectedHang) else "backend-loss"
+            elif isinstance(e, BackendSuspect):
+                kind = "hang"
+            else:
+                # a real RuntimeError: only treat as an outage if the
+                # bounded probe agrees the backend is gone — a genuine
+                # bug must not be silently retried into oblivion
+                kind = "error"
+                if (probe() if probe is not None
+                        else _default_probe(want_platform)) is not None:
+                    raise
+            if len(recoveries) >= max_recoveries:
+                log.error(
+                    "supervised run: %d recoveries exhausted; re-raising",
+                    max_recoveries,
+                )
+                raise
+            failed_step = done  # before the reload rewinds it
+            log.warning(
+                "supervised run lost the backend at step %d (%s: %s); "
+                "waiting for heal", failed_step, kind, e,
+            )
+            outcome = _wait_for_heal(policy, plan, want_platform, probe)
+            if not outcome.ok:
+                log.error(
+                    "backend never healed (%s after %.1fs); re-raising",
+                    outcome.stop_reason, outcome.elapsed_s,
+                )
+                raise
+            if make_solver is not None:
+                solver = make_solver()
+            loaded, quarantined = load_latest_generation(solver, ckpt_root)
+            if loaded is not None:
+                u, done = loaded
+            elif generation_dirs(ckpt_root):
+                # generations remain but none loads here (skipped, not
+                # quarantined): restarting at 0 would orphan them —
+                # surface the original failure instead
+                log.error(
+                    "recovery found unloadable (but intact) generations "
+                    "in %s; re-raising rather than restarting at step 0",
+                    ckpt_root,
+                )
+                raise
+            else:
+                # every generation was quarantined (proven corrupt):
+                # restarting from scratch is the only honest option, and
+                # the Recovery record says so (resumed_from=None)
+                u, done = solver.init_state(init), 0
+            recoveries.append(
+                Recovery(
+                    step=failed_step,  # where the failure hit, not the rewind
+                    kind=kind,
+                    error=f"{type(e).__name__}: {str(e)[:200]}",
+                    heal_wait_s=round(outcome.elapsed_s, 3),
+                    heal_attempts=len(outcome.attempts),
+                    resumed_from=done if loaded is not None else None,
+                    quarantined=quarantined,
+                )
+            )
+            log.info(
+                "backend healed (%s); resumed at step %d",
+                outcome.value, done,
+            )
+            continue
+        done = nxt
+
+    return SupervisedResult(
+        u=u,
+        steps_done=done,
+        start_step=start_step,
+        resumed_from=resumed_from,
+        residual=residual,
+        checkpoints_written=checkpoints,
+        recoveries=recoveries,
+        solver=solver,
+    )

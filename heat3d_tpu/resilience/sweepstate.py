@@ -1,0 +1,154 @@
+"""Resumable sweep state: an interrupted A/B session resumes at the first
+missing row.
+
+A measurement session is a sequence of rows (suite configs, A/B arms,
+profile stages). When the tunnel dies mid-session, the rows already landed
+must never be re-measured — a 30-minute healthy window should spend itself
+on the MISSING rows (round 5 lost stages 3b–3g exactly this way: the
+headline re-ran, the counterfactual arms never got their turn).
+
+``SweepState`` is an append-only JSONL journal of completed row keys.
+Appends are O(one line) and crash-safe in the only way that matters: a
+torn final line (power loss mid-append) is ignored on reload, so the worst
+case is re-measuring the one row whose record tore. The shell drivers use
+the CLI form::
+
+    python -m heat3d_tpu.resilience.sweepstate done  STATE KEY   # rc 0 if done
+    python -m heat3d_tpu.resilience.sweepstate mark  STATE KEY [JSON]
+    python -m heat3d_tpu.resilience.sweepstate list  STATE
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional
+
+
+class SweepState:
+    """Per-row completion journal backed by one JSONL file.
+
+    Keys are caller-chosen strings; make them a stable function of the
+    row's full configuration (the bench harness uses
+    :func:`row_key`), never of its position in the sweep — reordering
+    the sweep must not orphan completed work.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._done: Dict[str, dict] = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            f = open(self.path)
+        except OSError:
+            return
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a killed append
+                if isinstance(rec, dict) and "key" in rec:
+                    self._done[rec["key"]] = rec
+
+    def is_done(self, key: str) -> bool:
+        return key in self._done
+
+    def record(self, key: str) -> Optional[dict]:
+        return self._done.get(key)
+
+    def mark_done(self, key: str, record: Optional[dict] = None) -> None:
+        rec = {"key": key, "ts": time.time()}
+        if record is not None:
+            rec["record"] = record
+        self._done[key] = rec
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def pending(self, keys: Iterable[str]) -> List[str]:
+        return [k for k in keys if not self.is_done(k)]
+
+    def keys(self) -> List[str]:
+        return list(self._done)
+
+
+# Env knobs that select which kernel route a throughput row measures
+# (the A/B counterfactual arms in tpu_measure_all.sh flip exactly these).
+# They are row IDENTITY: two arms differing only in one of them must
+# never share a journal entry, or a resume re-emits arm 0's record as
+# arm 1's measurement.
+ROUTE_ENV_KNOBS = (
+    "HEAT3D_MEHRSTELLEN",
+    "HEAT3D_FACTOR_Y",
+    "HEAT3D_FACTOR_7PT",
+    "HEAT3D_NO_DIRECT",
+    "HEAT3D_DIRECT_INTERPRET",
+    "HEAT3D_DIRECT_FORCE",
+)
+
+
+def row_key(cfg, bench: str = "throughput") -> str:
+    """Stable row key for a bench config: every knob that changes what the
+    row measures — config fields AND the route env knobs — none that
+    doesn't (steps/repeats tune precision, not identity). Halo rows key
+    on the EXCHANGE SHAPE only (grid, mesh, storage dtype, transport —
+    run_suite's own dedup rule; route knobs don't touch the exchange):
+    the same physical halo measurement must hit the same journal entry no
+    matter which config in the sweep happened to land it first."""
+    g = "x".join(str(v) for v in cfg.grid.shape)
+    m = "x".join(str(v) for v in cfg.mesh.shape)
+    if bench == "halo":
+        return f"halo:g{g}:m{m}:{cfg.precision.storage}:h{cfg.halo}"
+    env_bits = ",".join(
+        f"{k}={os.environ[k]}" for k in ROUTE_ENV_KNOBS if k in os.environ
+    )
+    return (
+        f"{bench}:g{g}:m{m}:{cfg.stencil.kind}:{cfg.precision.storage}"
+        f":c{cfg.precision.compute}:b{cfg.backend}:tb{cfg.time_blocking}"
+        f":ov{int(cfg.overlap)}:h{cfg.halo}"
+        + (f":env[{env_bits}]" if env_bits else "")
+    )
+
+
+def _main(argv=None) -> int:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    usage = "usage: sweepstate {done|mark|list} STATE_FILE [KEY] [RECORD_JSON]"
+    if len(argv) < 2:
+        print(usage, file=sys.stderr)
+        return 2
+    cmd, path = argv[0], argv[1]
+    state = SweepState(path)
+    if cmd == "list":
+        for k in state.keys():
+            print(k)
+        return 0
+    if len(argv) < 3:
+        print(usage, file=sys.stderr)
+        return 2
+    key = argv[2]
+    if cmd == "done":
+        return 0 if state.is_done(key) else 1
+    if cmd == "mark":
+        record = json.loads(argv[3]) if len(argv) > 3 else None
+        state.mark_done(key, record)
+        return 0
+    print(usage, file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -116,27 +116,28 @@ def wait_for_backend(
     step on this wait: the probe child is itself timeout-bounded, and a
     probe killed while *waiting* for a claim never held one, so the wait
     loop cannot wedge the pool further.
-    """
-    import time
 
-    start = time.monotonic()
-    interval = interval_s
-    while True:
-        p = probe_platform()
-        if p is not None and (want is None or p == want):
-            return p
-        remaining = deadline_s - (time.monotonic() - start)
-        if remaining <= 0:
-            return None
-        # Back off (1.5x, capped at 5 min): every probe is a claim
-        # attempt, and a probe unlucky enough to be granted the chip just
-        # before its timeout can re-wedge the pool (see _probe). During a
-        # long outage, fewer attempts = fewer chances to hit that window;
-        # healing detection latency grows to at most the cap. The sleep is
-        # clamped to the remaining deadline so the wait still returns on
-        # time (one last probe fires right at the deadline edge).
-        time.sleep(min(interval, remaining))
-        interval = min(interval * 1.5, 300.0)
+    The wait routes through the ONE RetryPolicy implementation
+    (resilience.retry) with the claim-aware shape this module pioneered:
+    1.5x backoff capped at 5 min (every probe is a claim attempt — fewer
+    attempts during a long outage mean fewer chances to be granted the
+    chip just before the probe timeout and re-wedge the pool, see
+    ``_probe``), sleeps clamped to the remaining deadline so one last
+    probe fires right at the deadline edge.
+    """
+    from heat3d_tpu.resilience.retry import RetryPolicy
+
+    policy = RetryPolicy(
+        base_delay_s=interval_s,
+        multiplier=1.5,
+        max_delay_s=300.0,
+        deadline_s=deadline_s,
+    )
+    outcome = policy.run(
+        probe_platform,
+        success=lambda p: p is not None and (want is None or p == want),
+    )
+    return outcome.value if outcome.ok else None
 
 
 def install_sigterm_exit(code: int = 3) -> None:

@@ -11,12 +11,81 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Optional, Tuple
 
 import jax
 import numpy as np
 
 MANIFEST = "manifest.json"
+CRC_SUFFIX = ".crc32"
+
+
+class ShardCorruptError(Exception):
+    """A shard file's bytes disagree with its checksum sidecar.
+
+    Raised on load/stitch/consolidate reads (never silently repaired):
+    the caller decides whether to quarantine and fall back a generation
+    (the supervisor's policy) or abort."""
+
+    def __init__(self, path: str, filename: str, want: str, got: str):
+        self.path = path
+        self.filename = filename
+        super().__init__(
+            f"checkpoint {path}: shard {filename} fails its checksum "
+            f"(sidecar {want}, data {got}) — the file is corrupt; "
+            "quarantine it and fall back to the previous generation"
+        )
+
+
+def _crc32_hex(arr: np.ndarray) -> str:
+    return format(zlib.crc32(np.ascontiguousarray(arr).data), "08x")
+
+
+def _verify_enabled() -> bool:
+    return os.environ.get("HEAT3D_CKPT_VERIFY", "1").lower() not in (
+        "0",
+        "false",
+    )
+
+
+def _maybe_verify(path: str, fn: str, arr: np.ndarray) -> None:
+    """Check ``arr`` (the loaded shard ``fn``) against its CRC sidecar.
+
+    Sidecar-less shards pass (pre-checksum checkpoints stay loadable).
+    Works on memmaps too — crc32 streams the pages in without a second
+    full materialization."""
+    if not _verify_enabled():
+        return
+    try:
+        with open(os.path.join(path, fn + CRC_SUFFIX)) as f:
+            want = f.read().strip()
+    except OSError:
+        return
+    got = _crc32_hex(arr)
+    if got != want:
+        raise ShardCorruptError(path, fn, want, got)
+
+
+def quarantine(path: str, reason: str = "") -> str:
+    """Move a corrupt checkpoint directory (or single shard file) out of
+    the load path as ``<path>.quarantined[.N]`` — preserved for
+    post-mortem, invisible to generation scans. Returns the new path."""
+    base = path.rstrip(os.sep)
+    dest = base + ".quarantined"
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = f"{base}.quarantined.{n}"
+    os.rename(base, dest)
+    if reason:
+        try:
+            with open(dest + ".reason" if os.path.isfile(dest)
+                      else os.path.join(dest, "QUARANTINED"), "w") as f:
+                f.write(reason + "\n")
+        except OSError:
+            pass  # the rename is the load-path fix; the note is best-effort
+    return dest
 
 # np.save cannot represent ml_dtypes extension dtypes (bfloat16 -> raw '|V2');
 # store them as a same-width integer view and view back on load.
@@ -56,14 +125,36 @@ def _index_start(index, shape) -> Tuple[int, ...]:
 
 def save(path: str, u: jax.Array, step: int, extra: Optional[dict] = None) -> None:
     """Write the sharded field at ``path`` (a directory). Every process
-    writes its own shards; process 0 writes the manifest."""
+    writes its own shards; process 0 writes the manifest.
+
+    Each shard gets a ``<shard>.crc32`` sidecar (checksum of the saved
+    array bytes, written by the process that owns the shard — multi-host
+    safe, unlike checksums in the process-0 manifest, which could never
+    cover shards process 0 cannot read). Loads verify against it and
+    raise :class:`ShardCorruptError` on silent bit-rot."""
     os.makedirs(path, exist_ok=True)
     for shard in u.addressable_shards:
         start = _index_start(shard.index, u.shape)
-        np.save(
-            os.path.join(path, _shard_filename(start)),
-            _to_saveable(np.asarray(shard.data)),
-        )
+        fn = _shard_filename(start)
+        full = os.path.join(path, fn)
+        saveable = _to_saveable(np.asarray(shard.data))
+        # Crash-ordering: tmp-write the shard, UNLINK the old sidecar,
+        # replace the shard, then write the new sidecar. Every kill window
+        # degrades to "shard without sidecar" (loads unverified, like a
+        # legacy checkpoint) — never to new-bytes-under-old-digest, which
+        # would brand a good checkpoint corrupt on the next resume.
+        tmp = full + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, saveable)  # file handle: np.save can't append .npy
+        try:
+            os.unlink(full + CRC_SUFFIX)
+        except OSError:
+            pass
+        os.replace(tmp, full)
+        crc_tmp = full + CRC_SUFFIX + ".tmp"
+        with open(crc_tmp, "w") as f:
+            f.write(_crc32_hex(saveable))
+        os.replace(crc_tmp, full + CRC_SUFFIX)
     if jax.process_index() == 0:
         # Record the FULL save layout (every shard start, addressable or
         # not — derivable on process 0 from the global sharding), so load
@@ -81,6 +172,7 @@ def save(path: str, u: jax.Array, step: int, extra: Optional[dict] = None) -> No
             "global_shape": list(u.shape),
             "dtype": str(u.dtype),
             "format": 1,
+            "checksums": "crc32-sidecar",
             "shards": [list(s) for s in starts],
             "extra": extra or {},
         }
@@ -99,10 +191,11 @@ def _saved_blocks(path: str, ndim: int, allowed=None):
     """Enumerate the saved shard blocks as (start, shape, filename).
 
     Shapes come from the .npy headers via mmap — no block data is read
-    here. ``allowed`` (the manifest's recorded shard starts, when
-    present) filters out stale shard files a prior save with a different
-    mesh left in the directory; without it (pre-``shards`` manifests)
-    every shard file is trusted."""
+    here (checksums are paid lazily, at first data read). ``allowed``
+    (the manifest's recorded shard starts, when present) filters out
+    stale shard files a prior save with a different mesh left in the
+    directory; without it (pre-``shards`` manifests) every shard file is
+    trusted."""
     blocks = []
     for fn in sorted(os.listdir(path)):
         start = _parse_shard_start(fn)
@@ -115,11 +208,25 @@ def _saved_blocks(path: str, ndim: int, allowed=None):
     return blocks
 
 
-def _resolve_shard(path, shape, dtype_str, allowed, blocks, index):
+def _read_block(path: str, fn: str, verified: Optional[set] = None):
+    """mmap-open the block ``fn`` and checksum-verify it once per load
+    (``verified`` caches filenames across the shards of one restore, so a
+    block feeding several stitched shards pays one crc pass)."""
+    arr = np.load(os.path.join(path, fn), mmap_mode="r")
+    if verified is None or fn not in verified:
+        _maybe_verify(path, fn, arr)
+        if verified is not None:
+            verified.add(fn)
+    return arr
+
+
+def _resolve_shard(path, shape, dtype_str, allowed, blocks, index, verified=None):
     """Read the shard ``index`` selects, from its exactly-matching saved
     file when the manifest trusts it, else stitched from overlapping
     saved blocks. Returns ``(value, blocks)`` so the caller can reuse the
-    lazily-scanned block list across shards."""
+    lazily-scanned block list across shards. Every data read is
+    checksum-verified (``verified`` caches block filenames already
+    checked this restore)."""
     start = _index_start(index, shape)
     want = tuple(
         (0 if sl.stop is None else sl.stop) - (0 if sl.start is None else sl.start)
@@ -130,13 +237,16 @@ def _resolve_shard(path, shape, dtype_str, allowed, blocks, index):
         n if (sl.start is None and sl.stop is None) else w
         for sl, n, w in zip(index, shape, want)
     )
-    fname = os.path.join(path, _shard_filename(start))
+    shard_fn = _shard_filename(start)
+    fname = os.path.join(path, shard_fn)
     if (allowed is None or start in allowed) and os.path.exists(fname):
         # mmap probe: the header check must not pay a full read of a
         # wrong-shape block (the stitch below re-reads it lazily)
         arr = np.load(fname, mmap_mode="r")
         if arr.shape == want:
-            return _from_saved(np.array(arr), dtype_str), blocks
+            data = np.array(arr)
+            _maybe_verify(path, shard_fn, data)
+            return _from_saved(data, dtype_str), blocks
     # cross-mesh resume: stitch this shard from overlapping saved blocks
     if blocks is None:
         blocks = _saved_blocks(path, len(shape), allowed)
@@ -150,7 +260,7 @@ def _resolve_shard(path, shape, dtype_str, allowed, blocks, index):
         )
         if any(l >= h for l, h in zip(lo, hi)):
             continue
-        arr = np.load(os.path.join(path, bfn), mmap_mode="r")
+        arr = _read_block(path, bfn, verified)
         if out is None:
             out = np.empty(want, dtype=arr.dtype)
         dst = tuple(slice(l - s, h - s) for l, h, s in zip(lo, hi, start))
@@ -201,15 +311,18 @@ def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
         # has one) must not cost a full read just to fail the shape check
         arr = np.load(single, mmap_mode="r")
         if arr.shape == shape:
-            full = _from_saved(np.array(arr), dtype_str)
+            data = np.array(arr)
+            _maybe_verify(path, _shard_filename(zero), data)
+            full = _from_saved(data, dtype_str)
     blocks = None  # scanned lazily, only when a cross-mesh stitch is needed
+    verified: set = set()
 
     def cb(index):
         if full is not None:
             return full[index]
         nonlocal blocks
         value, blocks = _resolve_shard(
-            path, shape, dtype_str, allowed, blocks, index
+            path, shape, dtype_str, allowed, blocks, index, verified
         )
         return value
 
@@ -289,8 +402,13 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
                     "before consolidating"
                 )
         del fullmap
+        # partials beside the full block = a crash-recovery re-run: the
+        # zero block's bytes were merged by the CRASHED run, so any
+        # surviving sidecar predates them and is stale
+        recovery_had_partials = len(blocks) > len(already_full)
         blocks = already_full
     else:
+        recovery_had_partials = False
         # Coverage check done geometrically (clipped volumes + pairwise
         # overlap) rather than with a full-grid bool mask: at the pod
         # scales this tool exists for (4096^3) a mask alone is 64 GiB of
@@ -352,14 +470,47 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
             shape=shape,
         )
         try:
-            for bstart, bshape, bfn in blocks:
-                arr = np.load(os.path.join(path, bfn), mmap_mode="r")
-                dst = tuple(slice(b, b + w) for b, w in zip(bstart, bshape))
-                out[dst] = arr
-            out.flush()
-        finally:
-            del out
+            try:
+                for bstart, bshape, bfn in blocks:
+                    arr = _read_block(path, bfn)  # checksum-verified: never
+                    # merge silent bit-rot into the consolidated block
+                    dst = tuple(
+                        slice(b, b + w) for b, w in zip(bstart, bshape)
+                    )
+                    out[dst] = arr
+                out.flush()
+            finally:
+                del out
+        except BaseException:
+            # an aborted merge (e.g. a corrupt block failing its checksum)
+            # must not leave the FULL-grid-sized .tmp memmap behind — at
+            # the pod scales this tool documents that is a 256 GiB orphan
+            try:
+                os.unlink(tmp_data)
+            except OSError:
+                pass
+            raise
+        # same crash-ordering as save(): drop any stale sidecar BEFORE the
+        # bytes change, so a kill here degrades to "unverified", never to
+        # new-bytes-under-old-digest (which would brand the merged block
+        # corrupt and quarantine a good generation)
+        try:
+            os.unlink(final + CRC_SUFFIX)
+        except OSError:
+            pass
         os.replace(tmp_data, final)
+    # The merged zero block needs a FRESH sidecar whenever its bytes (may)
+    # have changed: the assembly above replaced them under the prior
+    # save's shard_0...npy.crc32, and a crash-recovery re-run inherits
+    # bytes the CRASHED run merged. The one case skipped is the pure
+    # no-op re-consolidate (already-full, in place, no partials): its
+    # sidecar is still valid and the refresh would cost a full read of a
+    # possibly-256 GiB block for zero information.
+    if not (already_full and in_place) or recovery_had_partials:
+        crc_tmp = final + CRC_SUFFIX + ".tmp"
+        with open(crc_tmp, "w") as f:
+            f.write(_crc32_hex(np.load(final, mmap_mode="r")))
+        os.replace(crc_tmp, final + CRC_SUFFIX)
     manifest["shards"] = [[0] * len(shape)]
     tmp = os.path.join(dest, MANIFEST + ".tmp")
     with open(tmp, "w") as f:
@@ -374,7 +525,11 @@ def consolidate(path: str, out_path: Optional[str] = None) -> str:
     # the load path can never read.
     if in_place:
         for fn in os.listdir(path):
-            if fn != zero_name and _parse_shard_start(fn) is not None:
+            base = fn[: -len(CRC_SUFFIX)] if fn.endswith(CRC_SUFFIX) else fn
+            # sidecars ride with their shard: removing a replaced partial
+            # must take its .crc32 too, or the directory accumulates
+            # digests of files that no longer exist
+            if base != zero_name and _parse_shard_start(base) is not None:
                 os.remove(os.path.join(path, fn))
     return dest
 

@@ -114,3 +114,16 @@ def percentile(values: List[float], q: float) -> float:
     s = sorted(values)
     k = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
     return s[k]
+
+
+def maybe_profile(profile_dir):
+    """``jax.profiler.trace`` context for ``profile_dir``, or a no-op
+    context without one — the single profiler bracket every entry point
+    (solver CLI, bench CLI, supervised runs) wraps its timed region in."""
+    import contextlib
+
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(profile_dir)

@@ -30,7 +30,14 @@ for i in $(seq 1 "$ATTEMPTS"); do
     echo "suite complete"
     exit 0
   fi
-  sleep 60
+  # Pacing between attempts routes through the shared RetryPolicy (jittered
+  # backoff, capped) instead of a bare sleep — the per-gate waiting inside
+  # each attempt already goes through it via backendprobe --wait. No sleep
+  # after the LAST attempt: nothing follows it but the failure exit.
+  [ "$i" -lt "$ATTEMPTS" ] && python heat3d_tpu/resilience/retry.py \
+    --attempt "$i" \
+    --base "${ATTEMPT_BACKOFF:-60}" --cap "${ATTEMPT_BACKOFF_CAP:-300}" \
+    --seed-extra "$(hostname)" --sleep
 done
 echo "attempt budget exhausted with $rows/$halos rows" >&2
 exit 1

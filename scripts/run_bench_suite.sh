@@ -55,6 +55,11 @@ wait_tpu() {
 # configs already recorded in $OUT are skipped, not re-run (no duplicate
 # table rows, no re-spending the session budget on finished rows)
 [[ -n "${APPEND:-}" ]] || : > "$OUT"
+# provenance-lint scope: only the rows THIS session appends. Pre-existing
+# rows (a resumed session's earlier attempts, or the committed legacy
+# record) were some other session's responsibility — linting them here
+# would keep every APPEND session permanently red.
+LINT_FROM=$(( $(wc -l < "$OUT" 2>/dev/null || echo 0) + 1 ))
 
 # has_halo GRID DTYPE -> 0 if $OUT already has the halo row for this
 # exchange shape (only consulted in APPEND mode). Checked separately from
@@ -202,3 +207,9 @@ fi
 # report refuses a zero-row rewrite itself (update_baseline_md), so a
 # session whose every row skipped leaves the committed tables untouched
 python -m heat3d_tpu.bench.report "$OUT" "$REPORT_MD"
+
+# Provenance lint LAST (after the report, so failing it never loses the
+# tables): rc 1 if any row THIS SESSION wrote has ts null/missing or
+# lacks its route fields (VERDICT r5 weak item 2, enforced going
+# forward). Its rc is the suite's rc under set -e.
+python scripts/check_provenance.py --start-line "$LINT_FROM" "$OUT"

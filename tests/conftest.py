@@ -50,3 +50,33 @@ def field8():
 
 
 FP32_TOL = 1e-5  # relative, single step
+
+
+def abstract_lowering_supported() -> bool:
+    """Whether this jax can compile-only-lower over an AbstractMesh — the
+    distributed-without-cluster validation tier (SURVEY.md §4, §7.0).
+    jax 0.4.x constructs the AbstractMesh (utils.compat shims the
+    constructor) but its jit lowering dies with ``_device_assignment is
+    not implemented for AbstractMesh``; the lowering tests skip-gate on
+    this probe instead of failing 20+ times with the same version gap."""
+    global _ABSTRACT_LOWERING_OK
+    if _ABSTRACT_LOWERING_OK is None:
+        import numpy as _np
+
+        from heat3d_tpu.core.config import MeshConfig
+        from heat3d_tpu.parallel.topology import lower_for_mesh
+        from jax.sharding import PartitionSpec
+
+        try:
+            lower_for_mesh(
+                lambda x: x + 1,
+                MeshConfig(shape=(2, 1, 1)),
+                ((4, 4, 4), _np.float32, PartitionSpec("x")),
+            )
+            _ABSTRACT_LOWERING_OK = True
+        except Exception:
+            _ABSTRACT_LOWERING_OK = False
+    return _ABSTRACT_LOWERING_OK
+
+
+_ABSTRACT_LOWERING_OK = None

@@ -33,6 +33,7 @@ from heat3d_tpu.ops.stencil_jnp import step_single_device
 from heat3d_tpu.parallel.halo import exchange_halo
 from heat3d_tpu.parallel.step import make_multistep_fn, make_step_fn
 from heat3d_tpu.parallel.topology import build_mesh, field_sharding
+from heat3d_tpu.utils.compat import shard_map
 
 
 def check_step_matches_single_device():
@@ -410,7 +411,7 @@ def check_halo_ghost_identity():
 
     for bc in (BoundaryCondition.PERIODIC, BoundaryCondition.DIRICHLET):
         f = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda x: exchange_halo(x, mesh_cfg, bc, bc_value=-1.0),
                 mesh=mesh,
                 in_specs=P("x", "y", "z"),
@@ -498,7 +499,7 @@ def check_dma_halo_ring_interpret():
         for periodic in (True, False):
             for width in (1, 2, 3):
                 got = jax.jit(
-                    jax.shard_map(
+                    shard_map(
                         lambda x: exchange_axis_dma(
                             x, axis, "x", 8, ("x",), periodic, 1.5,
                             width=width, interpret=True,
@@ -508,7 +509,7 @@ def check_dma_halo_ring_interpret():
                     )
                 )(u)
                 want = jax.jit(
-                    jax.shard_map(
+                    shard_map(
                         lambda x: exchange_axis(
                             x, axis, "x", 8, periodic, 1.5, width=width
                         ),
@@ -572,7 +573,7 @@ def check_fused_dma_overlap_ring_interpret():
                         (BoundaryCondition.PERIODIC, 0.0),
                     ]:
                         got = jax.jit(
-                            jax.shard_map(
+                            shard_map(
                                 lambda x, t=taps,
                                 p=bc is BoundaryCondition.PERIODIC,
                                 v=bcv: fused_mod.apply_step_fused_dma(
@@ -647,7 +648,7 @@ def check_fused_dma2_superstep_ring_interpret():
                     )
                     for bc, bcv in bcs:
                         got = jax.jit(
-                            jax.shard_map(
+                            shard_map(
                                 lambda x, t=taps,
                                 p=bc is BoundaryCondition.PERIODIC,
                                 v=bcv: fused_mod.apply_superstep_fused_dma(
@@ -705,7 +706,7 @@ def check_fused_dma_ghost_outputs_ring_interpret():
     u_dev = jax.device_put(u, NamedSharding(mesh, spec))
     bc, bcv = BoundaryCondition.DIRICHLET, 1.5
     out, glo, ghi = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: fused_mod.apply_step_fused_dma(
                 x, taps, axis_name="x", axis_size=8, mesh_axes=("x",),
                 periodic=False, bc_value=bcv, interpret=True,
@@ -739,7 +740,7 @@ def check_fused_dma_3d_glue():
     landed-ghost reuse as x faces, axis-ordered y/z face completion via
     exchange_halo_faces(x_ghosts=...), y/z shell patches) on REAL
     x-sharded block meshes == the single-device oracle — with the kernel
-    replaced by the semantics-faithful XLA mock (_mock_fused_step_xla).
+    replaced by its XLA reference contract (reference_fused_step_xla).
     Covers 7pt+27pt (corner propagation through the seeded faces),
     both BCs, fp32 + bf16-storage/fp32-compute, meshes (2,2,2)/(2,4,1)/
     (2,1,4)."""
@@ -778,7 +779,7 @@ def check_fused_dma_3d_glue():
                     u_dev = jax.device_put(u_in, sharding)
                     spec = P(*cfg.mesh.axis_names)
                     got = jax.jit(
-                        jax.shard_map(
+                        shard_map(
                             lambda x, t=taps, c=cfg:
                             _local_step_fused_dma_3d(
                                 x, t, c, reference_fused_step_xla
@@ -851,7 +852,7 @@ def check_fused_dma_edge_size_stress():
             )
             u_dev = jax.device_put(u_in, NamedSharding(mesh, spec))
             got = jax.jit(
-                jax.shard_map(
+                shard_map(
                     lambda x, t=taps, f=apply: f(
                         x, t, axis_name="x", axis_size=8, mesh_axes=("x",),
                         periodic=False, bc_value=bcv, interpret=True,

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import abstract_lowering_supported
+
 from heat3d_tpu.core.config import (
     BoundaryCondition,
     GridConfig,
@@ -31,6 +33,7 @@ from heat3d_tpu.parallel.step import (
     make_superstep_fn,
 )
 from heat3d_tpu.parallel.topology import abstract_mesh, build_mesh, lower_for_mesh
+from heat3d_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -85,7 +88,7 @@ def test_halo_111_mesh_equals_pad():
     ]:
         cfg = MeshConfig(shape=(1, 1, 1))
         mesh = build_mesh(cfg)
-        f = jax.shard_map(
+        f = shard_map(
             lambda x: exchange_halo(x, cfg, bc, bcv),
             mesh=mesh,
             in_specs=P("x", "y", "z"),
@@ -129,6 +132,10 @@ def test_overlap_rejects_tiny_local_blocks():
         make_step_fn(cfg, build_mesh(cfg.mesh))
 
 
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_overlap_multichip_lowers_with_collectives():
     cfg = SolverConfig(
         grid=GridConfig.cube(16),
@@ -231,6 +238,10 @@ def test_multistep_traced_step_count():
         ((4, 4, 4), "27pt"),  # config 4: v5p-64
     ],
 )
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_multichip_step_lowers_with_collectives(mesh_shape, kind):
     n = 16 if max(mesh_shape) <= 4 else 32
     cfg = SolverConfig(
@@ -250,6 +261,10 @@ def test_multichip_step_lowers_with_collectives(mesh_shape, kind):
     assert "all-reduce" in txt or "all_reduce" in txt  # the residual psum
 
 
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_bf16_strong_scale_config_lowers():
     # config 5: bf16 stencil + fp32 residual on a 128-chip mesh
     cfg = SolverConfig(
@@ -269,6 +284,10 @@ def test_bf16_strong_scale_config_lowers():
 
 
 @pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_dma_halo_step_lowers_for_multichip_tpu(kind):
     """The Pallas RDMA halo path (halo='dma') composes with the full step
     and lowers to Mosaic (tpu_custom_call) for a (2,2,2) mesh — the
@@ -291,6 +310,10 @@ def test_dma_halo_step_lowers_for_multichip_tpu(kind):
 
 
 @pytest.mark.parametrize("width", [2, 3])
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_dma_halo_superstep_lowers_for_multichip_tpu(width):
     """Temporal blocking over the RDMA transport: the width-k slab exchange
     (ops/halo_pallas.py) composes with the k-update superstep and lowers to
@@ -315,6 +338,10 @@ def test_dma_halo_superstep_lowers_for_multichip_tpu(width):
 
 
 @pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_faces_direct_step_lowers_for_multichip_tpu(kind, monkeypatch):
     """The multi-chip faces-direct step and tb=2 superstep — Mosaic direct
     kernels + faces-only ppermute exchange + shell patches — lower for a
@@ -350,6 +377,10 @@ def test_faces_direct_step_lowers_for_multichip_tpu(kind, monkeypatch):
         assert "tpu_custom_call" in txt2 and "collective_permute" in txt2
 
 
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_faces_direct_step_materializes_no_padded_volume(monkeypatch):
     """The architectural claim, checked mechanically in the lowered HLO:
     the exchange path concatenates a full (n+2)^3 padded copy of every
@@ -393,6 +424,10 @@ def test_unknown_halo_transport_rejected():
         SolverConfig(grid=GridConfig.cube(8), halo="nccl")
 
 
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_multistep_loop_is_device_side():
     cfg = SolverConfig(
         grid=GridConfig.cube(16),

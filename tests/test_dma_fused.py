@@ -14,6 +14,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from conftest import abstract_lowering_supported
 from jax.sharding import PartitionSpec as P
 
 from heat3d_tpu.core.config import (
@@ -105,6 +107,10 @@ def test_fused_dma_3d_dispatch_gate(monkeypatch):
 
 
 @pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_fused_dma_3d_step_lowers_for_multichip_tpu(kind, monkeypatch):
     """The full make_step_fn dispatch on the production (2,2,2) block mesh
     — fused kernel + y/z face ppermutes seeded by the landed ghosts +
@@ -180,6 +186,10 @@ def test_fused_dma_dispatch_gate(monkeypatch):
     "bc,bcv",
     [(BoundaryCondition.DIRICHLET, 1.5), (BoundaryCondition.PERIODIC, 0.0)],
 )
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_fused_dma_step_lowers_for_multichip_tpu(kind, bc, bcv, monkeypatch):
     """The full make_step_fn dispatch — fused DMA-overlap kernel on the
     production 3-axis (8,1,1) mesh — lowers to Mosaic with the residual
@@ -203,6 +213,10 @@ def test_fused_dma_step_lowers_for_multichip_tpu(kind, bc, bcv, monkeypatch):
     assert "all-reduce" in txt or "all_reduce" in txt  # residual psum
 
 
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_fused_dma_multichunk_lowers_for_tpu(monkeypatch):
     """Chunked-column mode (by < ny): the 8-row-aligned ghost-row blocks
     and the dynamic ghost-plane row slices lower for the TPU target."""
@@ -260,6 +274,10 @@ def test_fused_dma2_dispatch_gate(monkeypatch):
 
 
 @pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.skipif(
+    not abstract_lowering_supported(),
+    reason="this jax cannot jit-lower over AbstractMesh (0.4.x gap)",
+)
 def test_fused_dma2_superstep_lowers_for_multichip_tpu(kind, monkeypatch):
     """make_superstep_fn dispatches the fused DMA-overlap tb=2 kernel on
     the production 3-axis (8,1,1) mesh and lowers to Mosaic."""

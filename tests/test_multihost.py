@@ -206,4 +206,12 @@ def test_two_process_matches_single_process(extra, direct, monkeypatch, tmp_path
     )
     assert single.returncode == 0, single.stderr
     one = _summary(single.stdout)
-    assert two["residual_l2"] == pytest.approx(one["residual_l2"], rel=1e-6)
+    # Exchange-path arms run the SAME route on both sides: bitwise-level
+    # 1e-6 holds. The fused-dma arm compares the reference route against
+    # the exchange baseline, whose adds associate differently — that
+    # comparison gets the 1e-5 fp32 tier test_multidevice.py already
+    # uses (1e-6 passes today but is flaky across BLAS/XLA CPU builds).
+    fused_arm = "--halo" in extra
+    assert two["residual_l2"] == pytest.approx(
+        one["residual_l2"], rel=1e-5 if fused_arm else 1e-6
+    )

@@ -19,6 +19,7 @@ from heat3d_tpu.core.config import (
 )
 from heat3d_tpu.core.stencils import STENCILS, stencil_taps
 from heat3d_tpu.ops.stencil_jnp import apply_taps_padded
+from heat3d_tpu.utils.compat import shard_map
 from heat3d_tpu.ops.stencil_pallas import (
     apply_taps_pallas,
     choose_blocks,
@@ -95,7 +96,7 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
     u = jnp.asarray(np.random.default_rng(9).standard_normal((8, 8, 8)).astype(np.float32))
     spec = P("x", "y", "z")
 
-    want = jax.shard_map(
+    want = shard_map(
         lambda x: _local_stepk(x, taps, cfg, apply_taps_padded),
         mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
     )(u)
@@ -108,7 +109,7 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
             bc_value=bcv, interpret=True,
         )
 
-    got = jax.shard_map(
+    got = shard_map(
         fused, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
     )(u)
     np.testing.assert_allclose(
