@@ -35,26 +35,47 @@ def main(argv=None) -> int:
         "interrupted --bench all sweep resumes at the first missing row",
     )
     args = p.parse_args(argv)
-    cfg = config_from_args(args)
 
+    from heat3d_tpu import obs
     from heat3d_tpu.utils.timing import maybe_profile
 
-    profile_cm = maybe_profile(args.profile_dir)
-    profile_cm.__enter__()
+    # --ledger comes in through the inherited solver parser; the env
+    # fallback ($HEAT3D_LEDGER) is how run_bench_suite.sh threads ONE
+    # ledger through every row's subprocess. Activated BEFORE config
+    # validation, so a row dying on a bad config still leaves a record.
+    obs.activate(args.ledger, meta={"entry": "bench", "bench": args.bench})
     try:
-        if args.bench == "throughput":
-            import json
+        cfg = config_from_args(args)
+        profile_cm = maybe_profile(args.profile_dir)
+        profile_cm.__enter__()
+        try:
+            if args.bench == "throughput":
+                import json
 
-            print(json.dumps(bench_throughput(cfg, steps=args.steps,
-                                              repeats=args.repeats)))
-        elif args.bench == "halo":
-            import json
+                print(json.dumps(bench_throughput(cfg, steps=args.steps,
+                                                  repeats=args.repeats)))
+            elif args.bench == "halo":
+                import json
 
-            print(json.dumps(bench_halo(cfg, iters=args.iters)))
-        else:
-            run_suite([cfg], steps=args.steps, state_path=args.sweep_state)
-    finally:
-        profile_cm.__exit__(None, None, None)
+                print(json.dumps(bench_halo(cfg, iters=args.iters)))
+            else:
+                run_suite([cfg], steps=args.steps,
+                          state_path=args.sweep_state)
+        finally:
+            # the profiler trace flushes whatever happened; its own
+            # failure falls through to the outer handler, which records
+            # it — never masking a clean row as rc=0
+            profile_cm.__exit__(None, None, None)
+    except BaseException as e:
+        # the ledger must record HOW the row ended: a SIGTERM'd
+        # (SystemExit via install_sigterm_exit) or crashed row closing
+        # with rc=0 would read as a clean run in the post-mortem — the
+        # misattribution the ledger exists to prevent
+        obs.deactivate(rc=1, error=f"{type(e).__name__}: {str(e)[:200]}")
+        raise
+    obs.get().event("metrics_summary", metrics=obs.REGISTRY.snapshot())
+    obs.export_at_exit()
+    obs.deactivate(rc=0)
     return 0
 
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from heat3d_tpu import obs
 from heat3d_tpu.core.config import SolverConfig
 from heat3d_tpu.models.heat3d import HeatSolver3D
 from heat3d_tpu.parallel.step import exchange
@@ -31,6 +32,18 @@ from heat3d_tpu.utils.timing import (
     percentile,
     sync_overhead,
 )
+
+
+def _ledger_bench_row(row: Dict) -> None:
+    """Mirror a measured row into the run ledger. The row's ``ts`` (UTC
+    measurement-time string, the provenance key check_provenance.py
+    requires) collides with the ledger envelope's ``ts`` (unix float at
+    write time) — respell it ``ts_`` (the documented trailing-underscore
+    rule) so a consumer can still join ledger events to
+    bench_results.jsonl rows by timestamp."""
+    obs.get().event(
+        "bench_row", **{("ts_" if k == "ts" else k): v for k, v in row.items()}
+    )
 
 
 def _utc_now() -> str:
@@ -99,7 +112,7 @@ def bench_throughput(
     # EXPLICITLY so A/B tooling cannot mistake an emulated row for a real
     # Mosaic-kernel row without cross-checking the platform field
     fused_emulated = bool(fused and _kernel_env_gate(cfg)[1])
-    return {
+    row = {
         "bench": "throughput",
         # measurement time (UTC): lets a later outage round's fallback
         # prove WHICH session a carried committed row came from
@@ -122,6 +135,10 @@ def bench_throughput(
         "seconds_best": best,
         "seconds_all": times,
         "sync_rtt": rtt,
+        # canonical RTT provenance field (seconds) — REQUIRED by
+        # scripts/check_provenance.py on every bench row, so an
+        # RTT-dominated sample is auditable from the row alone
+        "sync_rtt_s": rtt,
         "rtt_dominated": rtt_dominated,
         "gcell_per_sec": gcells,
         "gcell_per_sec_per_chip": gcells / cfg.mesh.num_devices,
@@ -145,6 +162,11 @@ def bench_throughput(
         # tier rather than the Mosaic kernel (ADVICE r5 item 2)
         "fused_dma_emulated": fused_emulated,
     }
+    _ledger_bench_row(row)
+    obs.REGISTRY.histogram(
+        "bench_step_latency_seconds", "bench throughput per-step latency"
+    ).observe(best / steps)
+    return row
 
 
 def _resolved_fused_dma(cfg: SolverConfig) -> bool:
@@ -332,7 +354,13 @@ def bench_halo(
         + cfg.local_shape[0] * cfg.local_shape[1]
     )
     bytes_per_dev = 2 * face_cells * jnp.dtype(cfg.precision.storage).itemsize
-    return {
+    halo_hist = obs.REGISTRY.histogram(
+        "halo_exchange_latency_seconds",
+        "per-exchange halo latency (program mean)",
+    )
+    for t in times:
+        halo_hist.observe(t)
+    row = {
         "bench": "halo",
         "ts": _utc_now(),
         "platform": jax.default_backend(),
@@ -345,10 +373,14 @@ def bench_halo(
         "p95_mean_us": percentile(times, 95) * 1e6,
         "min_us": min(times) * 1e6,
         "sync_rtt_us": rtt * 1e6,
+        # canonical RTT provenance field, same contract as throughput rows
+        "sync_rtt_s": rtt,
         "rtt_dominated": rtt_dominated,
         "ici": cfg.mesh.num_devices > 1,
         "halo_bytes_per_device": bytes_per_dev,
     }
+    _ledger_bench_row(row)
+    return row
 
 
 def run_suite(
@@ -403,16 +435,27 @@ def run_suite(
                 r = done["record"]
                 results.append(r)
                 print(json.dumps(r), file=out, flush=True)
+                # re-emitted from the journal, NOT re-measured: the ledger
+                # must distinguish the two or a resumed A/B session reads
+                # as having measured rows it merely replayed
+                obs.get().event("bench_row_replayed", key=key)
                 return r
         plan.on_sweep_row(row_index)
         row_index += 1
-        r = measure()
+        with obs.get().span("bench_row_measure", key=key):
+            r = measure()
         results.append(r)
         print(json.dumps(r), file=out, flush=True)
         if state is not None:
             if want_platform is None or r.get("platform") == want_platform:
                 state.mark_done(key, r)
             else:
+                obs.get().event(
+                    "bench_row_pending",
+                    key=key,
+                    platform=r.get("platform"),
+                    want_platform=want_platform,
+                )
                 print(
                     f"suite: row {key} measured on "
                     f"{r.get('platform')!r}, not {want_platform!r} — left "

@@ -33,6 +33,7 @@ from heat3d_tpu.core.config import (
     SolverConfig,
     StencilConfig,
 )
+from heat3d_tpu import obs
 from heat3d_tpu.parallel import distributed
 from heat3d_tpu.utils.logging import emit_json, get_logger
 from heat3d_tpu.utils.timing import force_sync, maybe_profile
@@ -127,6 +128,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--profile-dir", default=None,
                    help="emit a jax.profiler trace (TensorBoard/Perfetto) here")
+    p.add_argument(
+        "--ledger", default=None, metavar="PATH",
+        help="append the run ledger (JSONL span/event stream) here; "
+        "defaults to $HEAT3D_LEDGER; inspect with `heat3d obs summary "
+        "PATH` (docs/OBSERVABILITY.md)",
+    )
     p.add_argument("--coordinator", default=None, help="multi-host coordinator addr:port")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
@@ -181,25 +188,59 @@ def config_from_args(args) -> SolverConfig:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # `heat3d obs ...` — the ledger-inspection surface (summary/tail/check)
+    # lives in its own subcommand parser, dispatched before the solver
+    # parser ever sees the argv
+    argv_l = list(sys.argv[1:] if argv is None else argv)
+    if argv_l and argv_l[0] == "obs":
+        from heat3d_tpu.obs.cli import main as obs_main
+
+        return obs_main(argv_l[1:])
     # A measurement script stopping this run with `timeout` (SIGTERM) must
     # release the axon pool's chip claim on the way out, not die holding it.
     from heat3d_tpu.utils.backendprobe import install_sigterm_exit
 
     install_sigterm_exit()
     try:
-        return _main(argv)
+        rc = _main(argv_l)
     except (ValueError, NotImplementedError) as e:
         # Config/capability errors (indivisible periodic meshes, halo='dma'
         # off-TPU, time_blocking constraints, ...) exit cleanly instead of
         # dumping a traceback — the reference's argv validation, done right.
         print(f"heat3d: error: {e}", file=sys.stderr)
+        obs.deactivate(rc=2, error=f"{type(e).__name__}: {str(e)[:200]}")
         return 2
+    except BaseException as e:
+        # the ledger must record HOW the run ended even on crashes and
+        # SIGTERM (SystemExit): close-with-error, then re-raise
+        obs.deactivate(rc=1, error=f"{type(e).__name__}: {str(e)[:200]}")
+        raise
+    obs.export_at_exit()
+    obs.deactivate(rc=rc)
+    return rc
 
 
 def _main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     distributed.initialize(args.coordinator, args.num_processes, args.process_id)
+    # activation order: after distributed.initialize (so the ledger pins
+    # the real process index), before config validation (so a run dying
+    # on a bad config still leaves a ledger_open + rc=2 close)
+    ledger = obs.activate(args.ledger, meta={"entry": "solve"})
     cfg = config_from_args(args)
+    ledger.event(
+        "run_start",
+        grid=list(cfg.grid.shape),
+        stencil=cfg.stencil.kind,
+        mesh=list(cfg.mesh.shape),
+        dtype=cfg.precision.storage,
+        backend=cfg.backend,
+        halo=cfg.halo,
+        overlap=cfg.overlap,
+        time_blocking=cfg.time_blocking,
+        steps=cfg.run.num_steps,
+        supervise=bool(args.supervise),
+    )
 
     dump_slice = None
     if args.dump_slice:
@@ -298,65 +339,92 @@ def _timed_run(args, cfg, solver, u, start_step):
     # (SURVEY.md §3.5: warmup iterations excluded). The dummy field is built
     # per-shard (zeros callback) so no process ever materializes the full
     # global array — same rule as init_state.
-    _dummy = solver.zeros_state
+    with obs.get().span("warmup"), obs.annotate("warmup"):
+        _dummy = solver.zeros_state
 
-    if cfg.run.tolerance is not None:
-        # while_loop cond is false at max_steps=0: compiles without advancing
-        solver.run_to_convergence(_dummy(), tol=1.0, max_steps=0)
-    else:
-        u = solver.run(u, 0)
-        jax.block_until_ready(solver.step_with_residual(_dummy()))
-    # force_sync, not block_until_ready: the latter returns before execution
-    # finishes under the axon remote tunnel (utils.timing docstring)
-    force_sync(u)
+        if cfg.run.tolerance is not None:
+            # while_loop cond is false at max_steps=0: compiles without
+            # advancing
+            solver.run_to_convergence(_dummy(), tol=1.0, max_steps=0)
+        else:
+            u = solver.run(u, 0)
+            jax.block_until_ready(solver.step_with_residual(_dummy()))
+        # force_sync, not block_until_ready: the latter returns before
+        # execution finishes under the axon remote tunnel (utils.timing
+        # docstring)
+        force_sync(u)
 
-    t0 = time.perf_counter()
     residual = None
-    if cfg.run.tolerance is not None:
-        result = solver.run_to_convergence(
-            u, tol=cfg.run.tolerance, max_steps=cfg.run.num_steps
-        )
-        u, residual = result.u, result.residual
-        done = result.steps
-    else:
-        total = cfg.run.num_steps
-        done = 0
-        while done < total:
-            # Advance to the next reporting boundary: a residual point, a
-            # checkpoint point, or the end. The final step is always a
-            # residual step, so exactly `total` updates run — no overshoot.
-            boundaries = [total]
-            if args.residual_every:
-                boundaries.append(
-                    (done // args.residual_every + 1) * args.residual_every
-                )
-            if args.checkpoint and args.checkpoint_every:
-                boundaries.append(
-                    (done // args.checkpoint_every + 1) * args.checkpoint_every
-                )
-            nxt = min(min(boundaries), total)
-            n = nxt - done
-            want_residual = nxt == total or (
-                args.residual_every and nxt % args.residual_every == 0
+    # One span for the whole timed region ("run_loop", with a `steps`
+    # field): the plain loop syncs the device only at the END, so per-chunk
+    # sub-spans would record async dispatch time, not execution — the
+    # honest per-step latency here is elapsed/steps, observed once. (The
+    # SUPERVISED loop force_syncs every chunk and gets real per-chunk
+    # spans — see resilience.supervisor.)
+    with obs.get().span("run_loop", step_start=start_step) as run_span:
+        t0 = time.perf_counter()
+        if cfg.run.tolerance is not None:
+            result = solver.run_to_convergence(
+                u, tol=cfg.run.tolerance, max_steps=cfg.run.num_steps
             )
-            if want_residual:
-                if n > 1:
-                    u = solver.run(u, n - 1)
-                u, r2 = solver.step_with_residual(u)
-                residual = float(np.sqrt(np.float64(r2)))
-                log.info("step %d residual %.6e", start_step + nxt, residual)
-            else:
-                u = solver.run(u, n)
-            done = nxt
-            if (
-                args.checkpoint
-                and args.checkpoint_every
-                and done % args.checkpoint_every == 0
-                and done < total  # final checkpoint written below
-            ):
-                solver.save_checkpoint(args.checkpoint, u, start_step + done)
-    force_sync(u)
-    elapsed = time.perf_counter() - t0
+            u, residual = result.u, result.residual
+            done = result.steps
+        else:
+            total = cfg.run.num_steps
+            done = 0
+            while done < total:
+                # Advance to the next reporting boundary: a residual point,
+                # a checkpoint point, or the end. The final step is always a
+                # residual step, so exactly `total` updates run — no
+                # overshoot.
+                boundaries = [total]
+                if args.residual_every:
+                    boundaries.append(
+                        (done // args.residual_every + 1) * args.residual_every
+                    )
+                if args.checkpoint and args.checkpoint_every:
+                    boundaries.append(
+                        (done // args.checkpoint_every + 1)
+                        * args.checkpoint_every
+                    )
+                nxt = min(min(boundaries), total)
+                n = nxt - done
+                want_residual = nxt == total or (
+                    args.residual_every and nxt % args.residual_every == 0
+                )
+                if want_residual:
+                    if n > 1:
+                        u = solver.run(u, n - 1)
+                    u, r2 = solver.step_with_residual(u)
+                    residual = float(np.sqrt(np.float64(r2)))
+                    log.info(
+                        "step %d residual %.6e", start_step + nxt, residual
+                    )
+                    obs.get().event(
+                        "residual",
+                        step=start_step + nxt,
+                        residual_l2=residual,
+                    )
+                else:
+                    u = solver.run(u, n)
+                done = nxt
+                if (
+                    args.checkpoint
+                    and args.checkpoint_every
+                    and done % args.checkpoint_every == 0
+                    and done < total  # final checkpoint written below
+                ):
+                    solver.save_checkpoint(
+                        args.checkpoint, u, start_step + done
+                    )
+        force_sync(u)
+        elapsed = time.perf_counter() - t0
+        run_span.add(steps=done, elapsed_s=elapsed)
+    if done:
+        obs.REGISTRY.histogram(
+            "step_latency_seconds",
+            "per-step wall latency (chunk dur / steps)",
+        ).observe(elapsed / done)
     return u, elapsed, start_step + done, residual
 
 
@@ -541,6 +609,11 @@ def _finish(
         tol = 1e-5 if fp32_chain else 5e-2
         summary["golden_pass"] = bool(rel < tol)
 
+    # the ledger's run_summary is the machine-readable mirror of the
+    # stdout JSON (every process writes its own ledger; stdout stays
+    # coordinator-only), followed by the final per-run metrics record
+    obs.get().event("run_summary", **summary)
+    obs.get().event("metrics_summary", metrics=obs.REGISTRY.snapshot())
     if distributed.is_coordinator():
         emit_json(summary)
     return 0

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from heat3d_tpu import obs
 from heat3d_tpu.core import golden
 from heat3d_tpu.core.config import Precision, SolverConfig
 from heat3d_tpu.parallel.step import (
@@ -187,21 +188,28 @@ class HeatSolver3D:
         region beyond ``cfg.grid.shape`` is pinned at bc_value (see
         parallel.step._pin_padding)."""
         true_shape = self.cfg.grid.shape
-        if isinstance(init, np.ndarray):
-            if init.shape != true_shape:
-                raise ValueError(f"init shape {init.shape} != grid {true_shape}")
-            arr = init.astype(self.storage_dtype)
+        with obs.get().span(
+            "init_state",
+            init=init if isinstance(init, str) else "array",
+            grid=list(true_shape),
+        ):
+            if isinstance(init, np.ndarray):
+                if init.shape != true_shape:
+                    raise ValueError(
+                        f"init shape {init.shape} != grid {true_shape}"
+                    )
+                arr = init.astype(self.storage_dtype)
+                return self._sharded_from_blocks(
+                    lambda clipped: arr[clipped]
+                )
+            if init == "hot-cube" and _device_init_enabled():
+                return self._device_field(hot_cube=True)
+            name, seed = init, self.cfg.run.seed
             return self._sharded_from_blocks(
-                lambda clipped: arr[clipped]
+                lambda clipped: golden.make_init_block(
+                    name, true_shape, clipped, seed=seed
+                ).astype(self.storage_dtype)
             )
-        if init == "hot-cube" and _device_init_enabled():
-            return self._device_field(hot_cube=True)
-        name, seed = init, self.cfg.run.seed
-        return self._sharded_from_blocks(
-            lambda clipped: golden.make_init_block(
-                name, true_shape, clipped, seed=seed
-            ).astype(self.storage_dtype)
-        )
 
     def _device_field(self, hot_cube: bool) -> jax.Array:
         """All-zero (or hot-cube) TRUE grid in storage layout, built on

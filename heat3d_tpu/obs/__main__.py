@@ -1,0 +1,8 @@
+"""``python -m heat3d_tpu.obs ...`` — the obs CLI (same surface as
+``heat3d obs ...``)."""
+
+import sys
+
+from heat3d_tpu.obs.cli import main
+
+sys.exit(main())

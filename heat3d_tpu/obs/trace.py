@@ -1,0 +1,73 @@
+"""Named-scope tracing: attribute device time to *our* phases.
+
+A jax.profiler trace of the solver shows raw XLA op names (fusion.123,
+dynamic-update-slice.7) — useless for answering "how much of the step is
+halo exchange vs stencil compute vs fused-DMA wait". These helpers bracket
+the phase boundaries the roofline analysis cares about:
+
+- :func:`named_phase` — ``jax.named_scope`` under the ``heat3d.`` prefix,
+  used INSIDE traced code (parallel/step.py, parallel/halo.py): the scope
+  name lands in every emitted op's metadata, so profiler tools (and
+  ``scripts/summarize_trace.py``'s phase table) can group device time by
+  phase instead of by op. Zero runtime cost — it only renames ops at trace
+  time.
+- :func:`annotate` — ``jax.profiler.TraceAnnotation`` for HOST-side
+  runtime regions (warmup, checkpoint IO, heal waits): shows up on the
+  host timeline of a captured trace.
+
+Phase names used by the step builders (the contract
+``summarize_trace.py --phases`` groups by; keep in sync with
+docs/OBSERVABILITY.md):
+
+- ``heat3d.halo_exchange`` (and ``heat3d.halo.<axis>`` per axis)
+- ``heat3d.stencil``
+- ``heat3d.fused_dma``
+- ``heat3d.residual``
+
+Everything imports lazily and degrades to a no-op context when jax is
+absent or too old, so the obs package stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+PHASE_PREFIX = "heat3d."
+
+
+def named_phase(name: str):
+    """``jax.named_scope('heat3d.<name>')`` — wrap traced (inside-jit)
+    code so emitted ops carry the phase in their metadata."""
+    if not name.startswith(PHASE_PREFIX):
+        name = PHASE_PREFIX + name
+    try:
+        import jax
+
+        return jax.named_scope(name)
+    except (ImportError, AttributeError):
+        return contextlib.nullcontext()
+
+
+def annotate(name: str, **kwargs):
+    """``jax.profiler.TraceAnnotation`` for host-side runtime regions —
+    visible on the host timeline when a profiler trace is being captured;
+    a cheap context either way."""
+    if not name.startswith(PHASE_PREFIX):
+        name = PHASE_PREFIX + name
+    try:
+        from jax.profiler import TraceAnnotation
+
+        return TraceAnnotation(name, **kwargs)
+    except (ImportError, AttributeError):
+        return contextlib.nullcontext()
+
+
+def scoped(name: str, fn):
+    """``fn`` wrapped in :func:`named_phase` — for decorating a built step
+    callable without restructuring it."""
+
+    def wrapper(*args, **kwargs):
+        with named_phase(name):
+            return fn(*args, **kwargs)
+
+    return wrapper

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
+from heat3d_tpu.obs.trace import named_phase
 
 
 def _shift_perm(n: int, direction: int, periodic: bool):
@@ -58,12 +59,15 @@ def exchange_axis(
         raise ValueError(
             f"halo width {width} exceeds local extent {n} on axis {axis}"
         )
-    lo_face = lax.slice_in_dim(u, 0, width, axis=axis)
-    hi_face = lax.slice_in_dim(u, n - width, n, axis=axis)
-    ghost_lo, ghost_hi = axis_ghosts(
-        lo_face, hi_face, axis_name, axis_size, periodic, bc_value
-    )
-    return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
+    # per-axis scope nested under heat3d.halo_exchange: trace tools can
+    # attribute ICI time to the axis whose permutes carry it
+    with named_phase(f"halo.{axis_name}"):
+        lo_face = lax.slice_in_dim(u, 0, width, axis=axis)
+        hi_face = lax.slice_in_dim(u, n - width, n, axis=axis)
+        ghost_lo, ghost_hi = axis_ghosts(
+            lo_face, hi_face, axis_name, axis_size, periodic, bc_value
+        )
+        return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
 
 
 def axis_ghosts(
@@ -158,7 +162,13 @@ def exchange_halo_faces(
         raise ValueError(
             f"halo width {w} exceeds a local extent of {u.shape}"
         )
+    with named_phase("halo_exchange"):
+        return _exchange_halo_faces(
+            u, names, sizes, periodic, bc_value, w, x_ghosts
+        )
 
+
+def _exchange_halo_faces(u, names, sizes, periodic, bc_value, w, x_ghosts):
     if x_ghosts is not None:
         xlo, xhi = x_ghosts
     else:

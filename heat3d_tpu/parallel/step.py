@@ -26,6 +26,7 @@ from heat3d_tpu.core.config import (
     SolverConfig,
 )
 from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
+from heat3d_tpu.obs.trace import named_phase
 from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
 from heat3d_tpu.parallel.halo import exchange_halo
 from heat3d_tpu.utils.compat import shard_map
@@ -80,17 +81,21 @@ def _pin_padding(u_new: jax.Array, cfg: SolverConfig) -> jax.Array:
 def exchange(
     u_local: jax.Array, cfg: SolverConfig, width: int = 1
 ) -> jax.Array:
-    """Ghost exchange via the configured transport (cfg.halo)."""
-    if cfg.halo == "dma":
-        from heat3d_tpu.ops.halo_pallas import exchange_halo_dma
+    """Ghost exchange via the configured transport (cfg.halo). The
+    ``heat3d.halo_exchange`` named scope brackets both transports so a
+    profiler trace attributes the permutes/DMAs to OUR phase, not to raw
+    XLA op names (scripts/summarize_trace.py groups on it)."""
+    with named_phase("halo_exchange"):
+        if cfg.halo == "dma":
+            from heat3d_tpu.ops.halo_pallas import exchange_halo_dma
 
-        return exchange_halo_dma(
-            u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value,
-            width=width,
+            return exchange_halo_dma(
+                u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value,
+                width=width,
+            )
+        return exchange_halo(
+            u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value, width
         )
-    return exchange_halo(
-        u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value, width
-    )
 
 
 def _pin_outside_domain(
@@ -146,14 +151,15 @@ def _local_stepk(
     compute_dtype = jnp.dtype(cfg.precision.compute)
     out_dtype = jnp.dtype(cfg.precision.storage)
     cur = exchange(u_local, cfg, width=k)
-    for j in range(k):
-        cur = compute_padded(
-            cur, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
-        )
-        rings = k - 1 - j  # ghost rings still carried by cur
-        if rings > 0:
-            cur = _fill_mid_ghosts(cur, cfg, rings)
-    return _pin_padding(cur, cfg)
+    with named_phase("stencil"):
+        for j in range(k):
+            cur = compute_padded(
+                cur, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+            )
+            rings = k - 1 - j  # ghost rings still carried by cur
+            if rings > 0:
+                cur = _fill_mid_ghosts(cur, cfg, rings)
+        return _pin_padding(cur, cfg)
 
 
 def _local_step(
@@ -163,13 +169,14 @@ def _local_step(
     compute_padded: LocalCompute,
 ) -> jax.Array:
     up = exchange(u_local, cfg)
-    u_new = compute_padded(
-        up,
-        taps,
-        compute_dtype=jnp.dtype(cfg.precision.compute),
-        out_dtype=jnp.dtype(cfg.precision.storage),
-    )
-    return _pin_padding(u_new, cfg)
+    with named_phase("stencil"):
+        u_new = compute_padded(
+            up,
+            taps,
+            compute_dtype=jnp.dtype(cfg.precision.compute),
+            out_dtype=jnp.dtype(cfg.precision.storage),
+        )
+        return _pin_padding(u_new, cfg)
 
 
 def _kernel_env_gate(cfg: SolverConfig):
@@ -564,18 +571,19 @@ def _local_step_fused_dma(
     cfg: SolverConfig,
     fused,
 ) -> jax.Array:
-    out = fused(
-        u_local,
-        taps,
-        axis_name=cfg.mesh.axis_names[0],
-        axis_size=cfg.mesh.shape[0],
-        mesh_axes=cfg.mesh.axis_names,
-        periodic=cfg.stencil.bc is BoundaryCondition.PERIODIC,
-        bc_value=cfg.stencil.bc_value,
-        compute_dtype=jnp.dtype(cfg.precision.compute),
-        out_dtype=jnp.dtype(cfg.precision.storage),
-    )
-    return _pin_padding(out, cfg)
+    with named_phase("fused_dma"):
+        out = fused(
+            u_local,
+            taps,
+            axis_name=cfg.mesh.axis_names[0],
+            axis_size=cfg.mesh.shape[0],
+            mesh_axes=cfg.mesh.axis_names,
+            periodic=cfg.stencil.bc is BoundaryCondition.PERIODIC,
+            bc_value=cfg.stencil.bc_value,
+            compute_dtype=jnp.dtype(cfg.precision.compute),
+            out_dtype=jnp.dtype(cfg.precision.storage),
+        )
+        return _pin_padding(out, cfg)
 
 
 def _local_step_fused_dma_3d(
@@ -606,18 +614,19 @@ def _local_step_fused_dma_3d(
     periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
     compute_dtype = jnp.dtype(cfg.precision.compute)
     out_dtype = jnp.dtype(cfg.precision.storage)
-    out, glo, ghi = fused(
-        u_local,
-        taps,
-        axis_name=cfg.mesh.axis_names[0],
-        axis_size=cfg.mesh.shape[0],
-        mesh_axes=cfg.mesh.axis_names,
-        periodic=periodic,
-        bc_value=cfg.stencil.bc_value,
-        compute_dtype=compute_dtype,
-        out_dtype=out_dtype,
-        return_ghosts=True,
-    )
+    with named_phase("fused_dma"):
+        out, glo, ghi = fused(
+            u_local,
+            taps,
+            axis_name=cfg.mesh.axis_names[0],
+            axis_size=cfg.mesh.shape[0],
+            mesh_axes=cfg.mesh.axis_names,
+            periodic=periodic,
+            bc_value=cfg.stencil.bc_value,
+            compute_dtype=compute_dtype,
+            out_dtype=out_dtype,
+            return_ghosts=True,
+        )
     # (ny, nz) -> (1, ny, nz) x-faces; Dirichlet x-edge devices substitute
     # the BC over the landed wrap transfer, exactly as the kernel reads it
     from heat3d_tpu.ops.stencil_dma_fused import substitute_dirichlet_x_edges
@@ -668,9 +677,10 @@ def _local_step_overlap(
     # Interior update from the local block alone (u_local acts as its own
     # ghost-padded input for the (nx-2, ny-2, nz-2) interior) — the bulk of
     # the FLOPs, scheduled while faces are in flight.
-    interior = compute_padded(
-        u_local, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
-    )
+    with named_phase("stencil"):
+        interior = compute_padded(
+            u_local, taps, compute_dtype=compute_dtype, out_dtype=out_dtype
+        )
     out = jnp.zeros((nx, ny, nz), out_dtype)
     out = lax.dynamic_update_slice(out, interior, (1, 1, 1))
 
@@ -721,19 +731,23 @@ def make_step_fn(
             periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
 
             def local_step(u_local, taps, cfg, compute_padded):
-                return direct(
-                    u_local,
-                    taps,
-                    periodic=periodic,
-                    bc_value=cfg.stencil.bc_value,
-                    compute_dtype=jnp.dtype(cfg.precision.compute),
-                    out_dtype=jnp.dtype(cfg.precision.storage),
-                )
+                with named_phase("stencil"):
+                    return direct(
+                        u_local,
+                        taps,
+                        periodic=periodic,
+                        bc_value=cfg.stencil.bc_value,
+                        compute_dtype=jnp.dtype(cfg.precision.compute),
+                        out_dtype=jnp.dtype(cfg.precision.storage),
+                    )
 
         else:
 
             def local_step(u_local, taps, cfg, compute_padded):
-                return _local_step_direct_faces(u_local, taps, cfg, direct)
+                with named_phase("stencil"):
+                    return _local_step_direct_faces(
+                        u_local, taps, cfg, direct
+                    )
 
     if cfg.overlap and direct is None:
         fused_dma = _fused_dma_fn(cfg)
@@ -788,8 +802,12 @@ def make_step_fn(
 
         def local(u_local):
             u_new = local_step(u_local, taps, cfg, compute_padded)
-            r = residual_sumsq(u_new, u_local, jnp.dtype(cfg.precision.residual))
-            r = lax.psum(r, axes)  # MPI_Allreduce analogue (SURVEY.md §3.3)
+            with named_phase("residual"):
+                r = residual_sumsq(
+                    u_new, u_local, jnp.dtype(cfg.precision.residual)
+                )
+                # MPI_Allreduce analogue (SURVEY.md §3.3)
+                r = lax.psum(r, axes)
             return u_new, r
 
         return shard_map(
@@ -868,14 +886,15 @@ def make_superstep_fn(
                 periodic2 = cfg.stencil.bc is BoundaryCondition.PERIODIC
 
                 def local2(u_local):
-                    return direct2(
-                        u_local,
-                        taps,
-                        periodic=periodic2,
-                        bc_value=cfg.stencil.bc_value,
-                        compute_dtype=jnp.dtype(cfg.precision.compute),
-                        out_dtype=jnp.dtype(cfg.precision.storage),
-                    )
+                    with named_phase("stencil"):
+                        return direct2(
+                            u_local,
+                            taps,
+                            periodic=periodic2,
+                            bc_value=cfg.stencil.bc_value,
+                            compute_dtype=jnp.dtype(cfg.precision.compute),
+                            out_dtype=jnp.dtype(cfg.precision.storage),
+                        )
 
             else:
                 _log_step_path_once(
@@ -884,9 +903,10 @@ def make_superstep_fn(
                 )
 
                 def local2(u_local):
-                    return _local_superstep_direct_faces(
-                        u_local, taps, cfg, direct2
-                    )
+                    with named_phase("stencil"):
+                        return _local_superstep_direct_faces(
+                            u_local, taps, cfg, direct2
+                        )
 
             return shard_map(
                 local2, mesh=mesh, in_specs=spec, out_specs=spec,
@@ -922,15 +942,16 @@ def make_superstep_fn(
 
         def local(u_local):
             up2 = exchange(u_local, cfg, width=2)
-            return fused(
-                up2,
-                taps,
-                mesh_axis_names=cfg.mesh.axis_names,
-                periodic=periodic,
-                bc_value=cfg.stencil.bc_value,
-                compute_dtype=jnp.dtype(cfg.precision.compute),
-                out_dtype=jnp.dtype(cfg.precision.storage),
-            )
+            with named_phase("stencil"):
+                return fused(
+                    up2,
+                    taps,
+                    mesh_axis_names=cfg.mesh.axis_names,
+                    periodic=periodic,
+                    bc_value=cfg.stencil.bc_value,
+                    compute_dtype=jnp.dtype(cfg.precision.compute),
+                    out_dtype=jnp.dtype(cfg.precision.storage),
+                )
 
     else:
 

@@ -150,18 +150,43 @@ class RetryPolicy:
         """
         import random as _random
 
+        from heat3d_tpu import obs
+
         jrng = rng if rng is not None else _random
         start = clock()
         attempts: List[Attempt] = []
+        ledger = obs.get()
+
+        def record(rec: Attempt) -> None:
+            # one observation pipeline for every exit path: the caller's
+            # on_attempt hook, the ledger's per-attempt event, the counter
+            if on_attempt is not None:
+                on_attempt(rec)
+            ledger.event(
+                "retry_attempt",
+                index=rec.index,
+                ok=rec.ok,
+                error=rec.error,
+                duration_s=round(rec.duration_s, 6),
+                slept_s=round(rec.slept_s, 6),
+            )
+            obs.REGISTRY.counter(
+                "retry_attempts_total", "RetryPolicy attempts"
+            ).inc(ok=str(rec.ok).lower())
 
         def outcome(ok, value, reason):
-            return RetryOutcome(
+            out = RetryOutcome(
                 ok=ok,
                 value=value,
                 stop_reason=reason,
                 elapsed_s=clock() - start,
                 attempts=attempts,
             )
+            ledger.event("retry_outcome", **out.to_record())
+            obs.REGISTRY.counter(
+                "retry_outcomes_total", "RetryPolicy.run results"
+            ).inc(reason=reason)
+            return out
 
         i = 0
         while True:
@@ -184,28 +209,24 @@ class RetryPolicy:
             )
             attempts.append(rec)
             if ok:
-                if on_attempt is not None:
-                    on_attempt(rec)
+                record(rec)
                 return outcome(True, value, "success")
             i += 1
             if self.max_attempts is not None and i >= self.max_attempts:
-                if on_attempt is not None:
-                    on_attempt(rec)
+                record(rec)
                 return outcome(False, None, "attempts")
             delay = self.delay_for(i, jrng)
             if self.deadline_s is not None:
                 remaining = self.deadline_s - (clock() - start)
                 if remaining <= 0:
-                    if on_attempt is not None:
-                        on_attempt(rec)
+                    record(rec)
                     return outcome(False, None, "deadline")
                 # clamp so the next (= last) attempt fires at the edge
                 delay = min(delay, remaining)
             # recorded unconditionally: the outcome's post-mortem value is
             # reconstructing the sleep schedule that actually ran
             rec.slept_s = delay
-            if on_attempt is not None:
-                on_attempt(rec)
+            record(rec)
             if delay > 0:
                 sleep(delay)
 

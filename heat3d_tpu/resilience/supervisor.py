@@ -44,6 +44,7 @@ import os
 import time
 from typing import Callable, List, Optional, Tuple
 
+from heat3d_tpu import obs
 from heat3d_tpu.resilience.faults import (
     FaultPlan,
     InjectedBackendLoss,
@@ -148,6 +149,14 @@ def save_generation(solver, u, step: int, root: str, keep: int = 2) -> str:
     load path then quarantines (no manifest) and skips."""
     gen = os.path.join(root, f"{GEN_PREFIX}{step:08d}")
     solver.save_checkpoint(gen, u, step)
+    # the generation TRANSITION is the supervisor-level fact (the save
+    # itself is the nested ckpt_save span): tag every later event with the
+    # new generation so a heal/resume session reads end to end
+    obs.get().event("generation_save", step=step, path=gen)
+    obs.get().set_context(generation=step)
+    obs.REGISTRY.counter(
+        "generation_transitions_total", "supervisor generation saves"
+    ).inc()
     gens = generation_dirs(root)
     for _, old in gens[:-keep] if keep > 0 else []:
         if os.path.realpath(old) == os.path.realpath(gen):
@@ -287,6 +296,10 @@ def run_supervised(
     recoveries: List[Recovery] = []
     checkpoints = 0
     resumed_from = None
+    ledger = obs.get()
+    step_hist = obs.REGISTRY.histogram(
+        "step_latency_seconds", "per-step wall latency (chunk dur / steps)"
+    )
 
     os.makedirs(ckpt_root, exist_ok=True)
     loaded, quarantined = load_latest_generation(solver, ckpt_root)
@@ -319,6 +332,16 @@ def run_supervised(
             "refusing to run backwards (raise --steps or point --checkpoint "
             "at a fresh directory)"
         )
+    ledger.set_context(generation=resumed_from)
+    ledger.event(
+        "supervised_start",
+        total_steps=total_steps,
+        start_step=start_step,
+        resumed_from=resumed_from,
+        checkpoint_every=checkpoint_every,
+        ckpt_root=ckpt_root,
+        quarantined=quarantined,
+    )
 
     residual = None
     while done < total_steps:
@@ -331,19 +354,29 @@ def run_supervised(
             nxt = total_steps
         n = nxt - done
         try:
-            plan.on_step(done, watchdog_s=watchdog_s)
-            t0 = time.monotonic()
-            if nxt == total_steps and finish_with_residual:
-                if n > 1:
-                    u = solver.run(u, n - 1)
-                u, r2 = solver.step_with_residual(u)
-                import numpy as np
+            # the chunk span covers fault hooks + the compiled steps + the
+            # sync, so an injected loss lands INSIDE it (status=error) and
+            # a healed session's timeline shows exactly which step window
+            # died; per-step latency (dur/n) feeds the same histogram the
+            # obs CLI reconstructs post-hoc from these spans
+            with ledger.span(
+                "chunk", step_start=done, step_end=nxt, steps=n
+            ) as chunk_span:
+                plan.on_step(done, watchdog_s=watchdog_s)
+                t0 = time.monotonic()
+                if nxt == total_steps and finish_with_residual:
+                    if n > 1:
+                        u = solver.run(u, n - 1)
+                    u, r2 = solver.step_with_residual(u)
+                    import numpy as np
 
-                residual = float(np.sqrt(np.float64(r2)))
-            else:
-                u = solver.run(u, n)
-            force_sync(u)
-            chunk_s = time.monotonic() - t0
+                    residual = float(np.sqrt(np.float64(r2)))
+                else:
+                    u = solver.run(u, n)
+                force_sync(u)
+                chunk_s = time.monotonic() - t0
+                chunk_span.add(steps_s=chunk_s)
+            step_hist.observe(chunk_s / n)
             if watchdog_s is not None and chunk_s > watchdog_s:
                 # the chunk RETURNED but blew its budget: a wedging tunnel
                 # slow-walks before it stops answering. Probe before
@@ -393,7 +426,15 @@ def run_supervised(
                 "supervised run lost the backend at step %d (%s: %s); "
                 "waiting for heal", failed_step, kind, e,
             )
-            outcome = _wait_for_heal(policy, plan, want_platform, probe)
+            with ledger.span(
+                "heal_wait", step=failed_step, failure=kind
+            ) as heal_span:
+                outcome = _wait_for_heal(policy, plan, want_platform, probe)
+                heal_span.add(
+                    ok=outcome.ok,
+                    attempts=len(outcome.attempts),
+                    stop_reason=outcome.stop_reason,
+                )
             if not outcome.ok:
                 log.error(
                     "backend never healed (%s after %.1fs); re-raising",
@@ -431,6 +472,15 @@ def run_supervised(
                     quarantined=quarantined,
                 )
             )
+            ledger.set_context(
+                generation=done if loaded is not None else None
+            )
+            rec_record = recoveries[-1].to_record()
+            rec_record["kind_"] = rec_record.pop("kind")  # envelope owns kind
+            ledger.event("recovery", **rec_record)
+            obs.REGISTRY.counter(
+                "recoveries_total", "survived supervised failures"
+            ).inc(kind=kind)
             log.info(
                 "backend healed (%s); resumed at step %d",
                 outcome.value, done,
@@ -438,6 +488,15 @@ def run_supervised(
             continue
         done = nxt
 
+    ledger.event(
+        "supervised_end",
+        steps_done=done,
+        start_step=start_step,
+        resumed_from=resumed_from,
+        checkpoints_written=checkpoints,
+        recoveries=len(recoveries),
+    )
+    ledger.set_context(generation=None)
     return SupervisedResult(
         u=u,
         steps_done=done,

@@ -45,7 +45,19 @@ def _probe(code: str, timeout: Optional[float]) -> Optional[str]:
     run its Python handler and still dies by the follow-up SIGKILL — but
     such a child was stuck BEFORE the claim grant; the dangerous
     granted-and-initializing window is Python-mediated and does yield."""
+    from heat3d_tpu import obs
+
     budget = probe_timeout() if timeout is None else timeout
+    with obs.get().span("backend_probe", timeout_s=budget) as sp:
+        result = _probe_inner(code, budget)
+        sp.add(ok=result is not None, result=result)
+    obs.REGISTRY.counter("backend_probes_total", "out-of-process probes").inc(
+        result="ok" if result is not None else "down"
+    )
+    return result
+
+
+def _probe_inner(code: str, budget: float) -> Optional[str]:
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", _SIGTERM_TO_EXIT + code],

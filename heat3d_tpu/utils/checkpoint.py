@@ -17,6 +17,8 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
+from heat3d_tpu import obs
+
 MANIFEST = "manifest.json"
 CRC_SUFFIX = ".crc32"
 
@@ -64,7 +66,16 @@ def _maybe_verify(path: str, fn: str, arr: np.ndarray) -> None:
         return
     got = _crc32_hex(arr)
     if got != want:
+        obs.REGISTRY.counter(
+            "ckpt_verify_total", "shard checksum verifications"
+        ).inc(result="corrupt")
+        obs.get().event(
+            "ckpt_corrupt", path=path, shard=fn, want=want, got=got
+        )
         raise ShardCorruptError(path, fn, want, got)
+    obs.REGISTRY.counter(
+        "ckpt_verify_total", "shard checksum verifications"
+    ).inc(result="ok")
 
 
 def quarantine(path: str, reason: str = "") -> str:
@@ -78,6 +89,10 @@ def quarantine(path: str, reason: str = "") -> str:
         n += 1
         dest = f"{base}.quarantined.{n}"
     os.rename(base, dest)
+    obs.REGISTRY.counter(
+        "ckpt_quarantine_total", "checkpoints renamed out of the load path"
+    ).inc()
+    obs.get().event("ckpt_quarantine", path=path, dest=dest, reason=reason)
     if reason:
         try:
             with open(dest + ".reason" if os.path.isfile(dest)
@@ -132,12 +147,22 @@ def save(path: str, u: jax.Array, step: int, extra: Optional[dict] = None) -> No
     safe, unlike checksums in the process-0 manifest, which could never
     cover shards process 0 cannot read). Loads verify against it and
     raise :class:`ShardCorruptError` on silent bit-rot."""
+    with obs.get().span("ckpt_save", path=path, step=int(step)) as _sp:
+        _save(path, u, step, extra, _sp)
+    obs.REGISTRY.counter("ckpt_writes_total", "checkpoint saves").inc()
+
+
+def _save(path, u, step, extra, _sp) -> None:
     os.makedirs(path, exist_ok=True)
+    nbytes = 0
+    nshards = 0
     for shard in u.addressable_shards:
         start = _index_start(shard.index, u.shape)
         fn = _shard_filename(start)
         full = os.path.join(path, fn)
         saveable = _to_saveable(np.asarray(shard.data))
+        nbytes += saveable.nbytes
+        nshards += 1
         # Crash-ordering: tmp-write the shard, UNLINK the old sidecar,
         # replace the shard, then write the new sidecar. Every kill window
         # degrades to "shard without sidecar" (loads unverified, like a
@@ -180,6 +205,7 @@ def save(path: str, u: jax.Array, step: int, extra: Optional[dict] = None) -> No
         with open(tmp, "w") as f:
             json.dump(manifest, f, indent=2)
         os.replace(tmp, os.path.join(path, MANIFEST))
+    _sp.add(shards=nshards, bytes=nbytes)
 
 
 def load_manifest(path: str) -> dict:
@@ -293,6 +319,13 @@ def load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
     that are not shared, cross-mesh resume needs the shard files
     consolidated first (same-mesh resume only ever touches local files).
     """
+    with obs.get().span("ckpt_load", path=path) as _sp:
+        u, step, extra = _load(path, sharding)
+        _sp.add(step=step)
+    return u, step, extra
+
+
+def _load(path: str, sharding) -> Tuple[jax.Array, int, dict]:
     manifest = load_manifest(path)
     shape = tuple(manifest["global_shape"])
     dtype_str = manifest["dtype"]

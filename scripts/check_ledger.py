@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Ledger schema lint — thin wrapper over heat3d_tpu.obs.check so the CI
+gate (scripts/run_bench_suite.sh) and the operator command
+(``heat3d obs check``) share one implementation.
+
+Checks every ledger file given: required fields on every event, span
+fields + monotonic span nesting, per-(run_id, proc) seq monotonicity, and
+run-id consistency (each run segment opens with exactly one
+``ledger_open``). rc 1 on any defect. ``--start-line N`` scopes the
+report to defects at/after line N (APPEND-mode suite sessions lint only
+the segments they wrote — same rule as check_provenance.py).
+
+Usage: scripts/check_ledger.py [--start-line N] LEDGER.jsonl [...]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from heat3d_tpu.obs.check import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,15 @@ class of gap loud at measurement time instead of at judging time: it FAILS
   ``direct_path``, ``mehrstellen_route``, ``fused_dma_path``,
   ``fused_dma_emulated``, ``chain_ops`` — ``chain_ops: null`` is legal
   only for ``backend: conv``, where a tap-chain op count does not exist), or
-- is a halo row missing ``platform``.
+- is a halo row missing ``platform``, or
+- is a bench row (either kind) missing a numeric ``sync_rtt_s`` — the
+  measured host round trip stamped by the harness (cached per backend in
+  utils.timing.sync_overhead); without it an ``rtt_dominated`` sample
+  cannot be audited from the row alone. A sweep JOURNAL recorded before
+  this field existed re-emits its rows verbatim on resume (byte-identical
+  replay is the journal's contract), so those replays fail too — by
+  design, same as legacy ``ts`` rows: re-land them in a healthy window or
+  start a fresh journal; do not weaken the lint.
 
 Wired into the bench report path (scripts/run_bench_suite.sh runs it after
 regenerating BASELINE.md, and its rc is the suite's rc), so a session
@@ -64,6 +72,13 @@ def check_row(r: dict) -> list:
     elif r.get("bench") == "halo":
         if "platform" not in r:
             problems.append("missing 'platform'")
+    if r.get("bench") in ("throughput", "halo") and not isinstance(
+        r.get("sync_rtt_s"), (int, float)
+    ):
+        problems.append(
+            "sync_rtt_s missing/non-numeric (RTT-dominated samples not "
+            "auditable from the row)"
+        )
     return problems
 
 

@@ -11,6 +11,17 @@ cd "$(dirname "$0")/.."
 
 OUT=${1:-bench_results.jsonl}
 REPORT_MD=${2:-${REPORT_MD:-BASELINE.md}}
+# One run ledger threads through every row's subprocess (the bench CLI
+# activates $HEAT3D_LEDGER itself): the suite's A/B session — including
+# rows replayed from a sweep journal vs freshly measured — reconstructs
+# from this file alone (`heat3d obs summary`). Fresh sessions truncate it
+# in lockstep with $OUT; APPEND sessions keep appending run segments.
+LEDGER="${LEDGER:-${OUT%.jsonl}.ledger.jsonl}"
+export HEAT3D_LEDGER="$LEDGER"
+[[ -n "${APPEND:-}" ]] || : > "$LEDGER"
+# ledger-lint scope: only the segments THIS session appends (same rule as
+# LINT_FROM below) — a historical defect must not keep resumed sessions red
+LEDGER_LINT_FROM=$(( $(wc -l < "$LEDGER" 2>/dev/null || echo 0) + 1 ))
 # Row stderr lands here (NOT /dev/null): a failing row's traceback is the
 # only evidence of WHY a session lost it. Fresh sessions truncate it in
 # lockstep with $OUT (stale tracebacks misattribute failures); APPEND
@@ -208,8 +219,11 @@ fi
 # session whose every row skipped leaves the committed tables untouched
 python -m heat3d_tpu.bench.report "$OUT" "$REPORT_MD"
 
-# Provenance lint LAST (after the report, so failing it never loses the
-# tables): rc 1 if any row THIS SESSION wrote has ts null/missing or
-# lacks its route fields (VERDICT r5 weak item 2, enforced going
-# forward). Its rc is the suite's rc under set -e.
+# Lints LAST (after the report, so failing them never loses the tables):
+# provenance — rc 1 if any row THIS SESSION wrote has ts null/missing,
+# lacks its route fields, or lacks sync_rtt_s (VERDICT r5 weak item 2,
+# enforced going forward); ledger — rc 1 if the session's event stream is
+# schema-invalid (missing fields, broken span nesting, torn run-ids).
+# Their rc is the suite's rc under set -e.
 python scripts/check_provenance.py --start-line "$LINT_FROM" "$OUT"
+python scripts/check_ledger.py --start-line "$LEDGER_LINT_FROM" "$LEDGER"

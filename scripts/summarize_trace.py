@@ -1,22 +1,116 @@
-"""Summarize a jax.profiler trace directory: per-op device time.
+"""Summarize a jax.profiler trace directory: per-op and per-PHASE device time.
 
 Reads the xplane protobuf the profiler writes and prints the top device ops
 by total self time — enough to attribute a roofline gap (DMA wait vs
-compute vs dispatch gaps) without shipping the trace to TensorBoard.
+compute vs dispatch gaps) without shipping the trace to TensorBoard. Ops
+emitted under the solver's ``jax.named_scope`` brackets (``heat3d.stencil``,
+``heat3d.halo_exchange``, ``heat3d.fused_dma``, ``heat3d.residual`` — see
+heat3d_tpu/obs/trace.py and docs/OBSERVABILITY.md) carry the scope in
+their metadata name, so the summary also aggregates device time by OUR
+phases instead of raw XLA op names.
+
+The aggregation logic is pure and duck-typed (``pick_line`` /
+``aggregate_line`` / ``phase_totals``) so tests drive it with synthetic
+plane objects when the ``xplane_pb2`` proto module is absent
+(tests/test_obs.py).
 """
 
 from __future__ import annotations
 
 import glob
 import os
+import re
 import sys
 from collections import defaultdict
+
+# innermost heat3d phase token in an op/metadata name: named_scope nests
+# (heat3d.stencil/heat3d.halo_exchange/...), and the INNERMOST scope is
+# the phase that op belongs to — findall + [-1] picks it. The (?!py\b)
+# lookahead keeps host-plane PYTHON FRAMES ("$heat3d.py:301 run") from
+# masquerading as a phase named "heat3d.py". Dotted sub-phases
+# ("heat3d.halo.x") are one token: the continuation admits further
+# components unless they open with a digit (XLA's ".N" op suffixes, as in
+# "fusion.2", are not phase path components).
+PHASE_RE = re.compile(
+    r"heat3d\.(?!py\b)[A-Za-z_][A-Za-z0-9_]*"
+    r"(?:\.(?!py\b)[A-Za-z_][A-Za-z0-9_]*)*"
+)
 
 
 def find_xplane(logdir: str):
     pats = os.path.join(logdir, "**", "*.xplane.pb")
     files = sorted(glob.glob(pats, recursive=True))
     return files[-1] if files else None
+
+
+def pick_line(lines):
+    """The ONE line to aggregate per plane. A device plane carries several
+    lines covering the SAME wall time (XLA Modules / XLA Ops / Steps);
+    summing across them would double-count. Pick the op-level line if
+    present, else the busiest line. ``lines`` must be pre-filtered to
+    non-empty (``ln.events``)."""
+
+    def line_us(line):
+        return sum(ev.duration_ps for ev in line.events) / 1e6
+
+    ops = [ln for ln in lines if "op" in ln.name.lower()]
+    return ops[0] if ops else max(lines, key=line_us)
+
+
+def aggregate_line(line, event_metadata):
+    """(totals_us, counts) per metadata name for one line's events.
+    ``event_metadata`` is the plane's metadata_id -> metadata mapping
+    (proto map or plain dict of objects with ``.name``)."""
+    totals = defaultdict(float)
+    counts = defaultdict(int)
+    for ev in line.events:
+        meta = event_metadata[ev.metadata_id]
+        totals[meta.name] += ev.duration_ps / 1e6
+        counts[meta.name] += 1
+    return totals, counts
+
+
+def phase_name(op_name: str):
+    """The heat3d phase an op belongs to (its innermost ``heat3d.*`` scope
+    token), or None for ops outside any named phase."""
+    hits = PHASE_RE.findall(op_name)
+    return hits[-1] if hits else None
+
+
+def phase_totals(totals):
+    """Group per-op totals by heat3d phase; unscoped time lands in
+    ``(unattributed)``."""
+    phases = defaultdict(float)
+    for name, us in totals.items():
+        phases[phase_name(name) or "(unattributed)"] += us
+    return dict(phases)
+
+
+def summarize_plane(plane, top: int = 25, out=None) -> None:
+    out = out or sys.stdout
+    lines = [ln for ln in plane.lines if ln.events]
+    if not lines:
+        return
+    line = pick_line(lines)
+    totals, counts = aggregate_line(line, plane.event_metadata)
+    print(
+        f"\n== {plane.name} [line: {line.name or '?'}] "
+        f"(total {sum(totals.values())/1e3:.2f} ms)",
+        file=out,
+    )
+    for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"  {us/1e3:9.3f} ms  x{counts[name]:<6} {name[:90]}", file=out)
+    phases = phase_totals(totals)
+    # a phase table with ONLY unattributed time is noise (a trace captured
+    # without the named scopes); print it when any phase resolved
+    if set(phases) - {"(unattributed)"}:
+        total_us = sum(phases.values()) or 1.0
+        print("  -- by heat3d phase --", file=out)
+        for name, us in sorted(phases.items(), key=lambda kv: -kv[1]):
+            print(
+                f"  {us/1e3:9.3f} ms  {100.0 * us / total_us:5.1f}%  {name}",
+                file=out,
+            )
 
 
 def summarize(path: str) -> int:
@@ -41,30 +135,7 @@ def summarize(path: str) -> int:
     if not planes:  # CPU-only trace: fall back to the host plane
         planes = [p for p in xs.planes if p.lines]
     for plane in planes:
-        # A device plane carries several lines covering the SAME wall time
-        # (XLA Modules / XLA Ops / Steps); summing across them would double-
-        # count. Aggregate one line only: the op-level line if present, else
-        # the busiest line.
-        def line_us(line):
-            return sum(ev.duration_ps for ev in line.events) / 1e6
-
-        lines = [ln for ln in plane.lines if ln.events]
-        if not lines:
-            continue
-        ops = [ln for ln in lines if "op" in ln.name.lower()]
-        line = ops[0] if ops else max(lines, key=line_us)
-        totals = defaultdict(float)
-        counts = defaultdict(int)
-        for ev in line.events:
-            meta = plane.event_metadata[ev.metadata_id]
-            totals[meta.name] += ev.duration_ps / 1e6
-            counts[meta.name] += 1
-        print(
-            f"\n== {plane.name} [line: {line.name or '?'}] "
-            f"(total {sum(totals.values())/1e3:.2f} ms)"
-        )
-        for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[:25]:
-            print(f"  {us/1e3:9.3f} ms  x{counts[name]:<6} {name[:90]}")
+        summarize_plane(plane)
     return 0
 
 

@@ -568,7 +568,11 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
         "bench": "throughput", "ts": "2026-01-01T00:00:00Z",
         "platform": "tpu", "direct_path": True, "mehrstellen_route": False,
         "fused_dma_path": False, "fused_dma_emulated": False,
-        "chain_ops": 7, "backend": "auto",
+        "chain_ops": 7, "backend": "auto", "sync_rtt_s": 7.5e-2,
+    }
+    halo_good = {
+        "bench": "halo", "ts": "2026-01-01T00:00:00Z", "platform": "tpu",
+        "sync_rtt_s": 7.5e-2,
     }
     rows = [
         good,
@@ -576,14 +580,18 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
         {k: v for k, v in good.items() if k != "fused_dma_emulated"},
         {**good, "chain_ops": None},               # null ops on non-conv
         {**good, "chain_ops": None, "backend": "conv"},  # legal for conv
-        {"bench": "halo", "ts": "2026-01-01T00:00:00Z", "platform": "tpu"},
-        {"bench": "halo", "ts": "2026-01-01T00:00:00Z"},  # no platform
+        halo_good,
+        {k: v for k, v in halo_good.items() if k != "platform"},
         {"metric": "gcell_updates_per_sec_per_chip"},  # foreign line: pass
+        # RTT provenance (obs PR): a bench row without its measured
+        # sync_rtt_s cannot be audited for RTT domination
+        {k: v for k, v in good.items() if k != "sync_rtt_s"},
+        {**halo_good, "sync_rtt_s": None},
     ]
     p = tmp_path / "r.jsonl"
     p.write_text("\n".join(json.dumps(r) for r in rows))
     bad = mod.check_file(str(p))
-    assert [line for line, _ in bad] == [2, 3, 4, 7]
+    assert [line for line, _ in bad] == [2, 3, 4, 7, 9, 10]
     assert mod.main([str(p)]) == 1
 
     ok = tmp_path / "ok.jsonl"
