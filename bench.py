@@ -15,7 +15,10 @@ the middle of the 50-200 roofline band.
 
 Resilience contract (this artifact must NEVER die unparsed): the parent
 process NEVER touches jax. It probes the backend in a killable subprocess
-(retry/backoff), then runs every measurement rung in a killable child with
+(retry/backoff; skipped outright — one ``probe_skipped`` ledger event —
+when ``JAX_PLATFORMS=cpu`` pins the platform or a backend is already
+initialized, so CPU bench runs don't burn the ~8-minute probe ladder),
+then runs every measurement rung in a killable child with
 a timeout — so even a backend that wedges AFTER a successful probe (the
 round-2 failure mode: jax init/compile hanging forever over the axon
 tunnel) costs one rung timeout, not the artifact. Failed/hung rungs walk a
@@ -128,6 +131,69 @@ def _probe_with_retry():
 def _emit(rec) -> int:
     print(json.dumps(rec))
     return 0
+
+
+def _platform_fast_path():
+    """Skip the probe/retry loop when probing cannot be necessary.
+
+    The probe loop exists for ONE hazard: the axon remote-TPU tunnel,
+    whose first in-process jax init can hang indefinitely on a wedged
+    lease. When the env pins the CPU platform (``JAX_PLATFORMS=cpu``), or
+    jax is ALREADY initialized in this process (the hazard, if any, has
+    passed), no probe can change the answer — yet the default 8 x 60 s
+    probe/backoff loop still burned ~8 minutes per CPU bench run before
+    reporting ``tpu_unavailable`` (BENCH_r05.json tail). Returns the known
+    platform, or None when real probing is warranted; the caller records
+    a ``probe_skipped`` ledger event for the fast path so the run's
+    post-mortem shows WHY no backend_probe spans exist."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    first = platforms.split(",")[0].strip().lower()
+    if first == "cpu":
+        return "cpu", "JAX_PLATFORMS=cpu pins the platform"
+    try:  # initialized-backend check: never triggers an init itself
+        if "jax" in sys.modules:
+            from jax._src import xla_bridge
+
+            if xla_bridge.backends_are_initialized():
+                import jax
+
+                return (
+                    jax.default_backend(),
+                    "backend already initialized in-process",
+                )
+    except (ImportError, AttributeError, RuntimeError):
+        pass  # private-API drift or unqueryable state: probe normally
+    return None
+
+
+def _record_probe_skipped(platform: str, reason: str) -> None:
+    """One ``probe_skipped`` ledger event (active only under
+    HEAT3D_LEDGER, e.g. a suite run).
+
+    Written from a BOUNDED KILLABLE CHILD, not in-process: importing
+    ``heat3d_tpu`` pulls in jax via the package __init__, and this file's
+    resilience contract is that the parent NEVER touches jax (a wedged
+    import must cost one child timeout, not the artifact). No ledger
+    configured -> no child at all. Fails soft like all telemetry."""
+    if not os.environ.get("HEAT3D_LEDGER"):
+        return
+    code = (
+        "from heat3d_tpu import obs; "
+        "obs.activate(meta={'entry': 'bench-parent'}); "
+        f"obs.get().event('probe_skipped', platform={platform!r}, "
+        f"reason={reason!r}); "
+        "obs.deactivate()"
+    )
+    try:
+        subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=60,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+    except Exception:  # noqa: BLE001 - telemetry must not cost the artifact
+        pass
 
 
 def _child_main() -> int:
@@ -300,7 +366,13 @@ def main() -> int:
     if os.environ.get("HEAT3D_BENCH_CHILD"):
         return _child_main()
 
-    platform = _probe_with_retry()
+    fast = _platform_fast_path()
+    if fast is not None:
+        platform, reason = fast
+        sys.stderr.write(f"bench: probe skipped ({reason})\n")
+        _record_probe_skipped(platform, reason)
+    else:
+        platform = _probe_with_retry()
     if platform is None:
         return _cpu_fallback("tpu_unavailable")
 

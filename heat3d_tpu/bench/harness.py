@@ -112,6 +112,27 @@ def bench_throughput(
     # EXPLICITLY so A/B tooling cannot mistake an emulated row for a real
     # Mosaic-kernel row without cross-checking the platform field
     fused_emulated = bool(fused and _kernel_env_gate(cfg)[1])
+    # cost-analysis provenance (obs/perf/roofline): XLA's own FLOPs/bytes
+    # for ONE step of this config, so a row's achieved-vs-peak is
+    # computable from the row alone (`obs summary` roofline section,
+    # `obs roofline`). One extra step-program compile per row
+    # (HEAT3D_COST_ANALYSIS=0 skips); failures leave the fields null with
+    # the error recorded — telemetry never fails the row.
+    cost_fields = {"cost_flops_per_step": None, "cost_bytes_per_step": None}
+    try:
+        from heat3d_tpu.obs.perf.roofline import (
+            cost_analysis_enabled,
+            step_cost_fields,
+        )
+
+        if cost_analysis_enabled():
+            cost_fields.update(step_cost_fields(solver))
+    except Exception as e:  # noqa: BLE001 - telemetry fails soft, incl.
+        # import-time drift in the perf package: the measured row lands
+        # with null cost fields + the error, never dies
+        cost_fields["cost_analysis_error"] = (
+            f"{type(e).__name__}: {str(e)[:120]}"
+        )
     row = {
         "bench": "throughput",
         # measurement time (UTC): lets a later outage round's fallback
@@ -161,6 +182,7 @@ def bench_throughput(
         # ... and whether that resolution was the XLA reference EMULATION
         # tier rather than the Mosaic kernel (ADVICE r5 item 2)
         "fused_dma_emulated": fused_emulated,
+        **cost_fields,
     }
     _ledger_bench_row(row)
     obs.REGISTRY.histogram(

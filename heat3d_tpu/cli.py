@@ -126,8 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--max-recoveries", type=int, default=3,
         help="(with --supervise) give up after this many survived failures",
     )
-    p.add_argument("--profile-dir", default=None,
-                   help="emit a jax.profiler trace (TensorBoard/Perfetto) here")
+    p.add_argument("--profile", "--profile-dir", dest="profile_dir",
+                   default=None, metavar="DIR",
+                   help="capture a jax.profiler trace (TensorBoard/"
+                   "Perfetto) of the timed region into DIR; the artifact "
+                   "path and the capture overhead are recorded into the "
+                   "run ledger as a profile_capture event "
+                   "(docs/OBSERVABILITY.md). --profile-dir is the legacy "
+                   "spelling")
     p.add_argument(
         "--ledger", default=None, metavar="PATH",
         help="append the run ledger (JSONL span/event stream) here; "
@@ -299,6 +305,18 @@ def _main(argv: Optional[List[str]] = None) -> int:
             "carry"
         )
     solver = HeatSolver3D(cfg)
+
+    # cost-analysis provenance: one step_cost ledger event (XLA-counted
+    # FLOPs/bytes of the step executable) so `obs summary` can print the
+    # run's achieved-vs-peak line. Telemetry fails soft, never the run —
+    # the guard covers import-time drift in the perf package too (the
+    # same posture bench.harness takes on its row cost fields).
+    try:
+        from heat3d_tpu.obs.perf.roofline import record_step_cost
+
+        record_step_cost(solver)
+    except Exception as e:  # noqa: BLE001 - telemetry fails soft
+        log.warning("step_cost telemetry unavailable: %s", e)
 
     if args.supervise:
         return _main_supervised(args, cfg, solver, dump_slice)

@@ -1,4 +1,7 @@
-"""Unified telemetry: run ledger, metrics registry, named-span tracing.
+"""Unified telemetry: run ledger, metrics registry, named-span tracing —
+plus the :mod:`~heat3d_tpu.obs.perf` layer that judges what they record
+(profile capture, roofline attribution, the perf-regression gate,
+multihost ledger merge; docs/OBSERVABILITY.md §5).
 
 Three instruments, one package (see docs/OBSERVABILITY.md):
 

@@ -6,6 +6,9 @@ Subcommands::
     heat3d obs summary LEDGER [--run RUN_ID]   # per-run spans + timeline
     heat3d obs tail LEDGER [-n N]              # last N events, one per line
     heat3d obs check LEDGER [...]              # schema lint (scripts/check_ledger.py)
+    heat3d obs roofline [...]                  # achieved-vs-peak (obs/perf/roofline)
+    heat3d obs regress RESULTS [...]           # perf-regression gate (obs/perf/regress)
+    heat3d obs merge LEDGERS... [...]          # multihost timeline join (obs/perf/merge)
 
 ``summary`` is the operator's post-mortem view: for each run segment in
 the ledger it prints the invocation, a span-duration table (count, total,
@@ -102,6 +105,97 @@ def step_latencies(events: List[Dict[str, Any]]) -> List[float]:
     return out
 
 
+def _achieved_line(
+    label: str,
+    flops: Any,
+    bytes_: Any,
+    per_step_s: Any,
+    platform: str,
+) -> Optional[str]:
+    """One roofline line: achieved GFLOP/s / GB/s for a per-step cost
+    record against the platform's peak spec (obs/perf/roofline.py), or
+    None when the record is incomplete."""
+    if not (
+        isinstance(per_step_s, (int, float))
+        and per_step_s > 0
+        and (isinstance(flops, (int, float)) or isinstance(bytes_, (int, float)))
+    ):
+        return None
+    from heat3d_tpu.obs.perf.roofline import peak_spec
+
+    spec = peak_spec(platform)
+    parts = []
+    if isinstance(flops, (int, float)):
+        g = flops / per_step_s / 1e9
+        peak = spec.get("vector_gflops")
+        pct = f" ({g / peak:.1%} of peak)" if peak else ""
+        parts.append(f"{g:.2f} GFLOP/s{pct}")
+    if isinstance(bytes_, (int, float)):
+        g = bytes_ / per_step_s / 1e9
+        peak = spec.get("mem_gbps")
+        pct = f" ({g / peak:.1%} of peak)" if peak else ""
+        parts.append(f"{g:.2f} GB/s{pct}")
+    return f"   roofline {label} [{platform}]: " + "  ".join(parts)
+
+
+def roofline_lines(events: List[Dict[str, Any]]) -> List[str]:
+    """The ``roofline`` section of a run summary: achieved-vs-peak lines
+    joining (a) bench_row events that carry the cost-analysis fields with
+    their own measured seconds, and (b) a ``step_cost`` event with the
+    run_loop span's per-step latency. Empty when the run recorded no cost
+    telemetry; never raises (telemetry display fails soft too)."""
+    lines: List[str] = []
+    try:
+        for r in events:
+            if r.get("event") == "bench_row" and (
+                isinstance(r.get("cost_flops_per_step"), (int, float))
+                or isinstance(r.get("cost_bytes_per_step"), (int, float))
+            ):
+                steps = r.get("steps")
+                sec = r.get("seconds_best")
+                if isinstance(steps, int) and steps > 0 and isinstance(
+                    sec, (int, float)
+                ):
+                    grid = "x".join(str(g) for g in (r.get("grid") or []))
+                    line = _achieved_line(
+                        f"bench {grid} tb={r.get('time_blocking', 1)}",
+                        r.get("cost_flops_per_step"),
+                        r.get("cost_bytes_per_step"),
+                        sec / steps,
+                        str(r.get("platform", "?")),
+                    )
+                    if line:
+                        lines.append(line)
+        costs = [
+            r
+            for r in events
+            if r.get("event") == "step_cost" and r.get("ok")
+        ]
+        loops = [
+            r
+            for r in events
+            if r.get("kind") == "span"
+            and r.get("event") == "run_loop"
+            and isinstance(r.get("steps"), int)
+            and r["steps"] > 0
+            and isinstance(r.get("dur_s"), (int, float))
+        ]
+        if costs and loops:
+            c, lp = costs[0], loops[0]
+            line = _achieved_line(
+                "run_loop",
+                c.get("cost_flops_per_step"),
+                c.get("cost_bytes_per_step"),
+                lp["dur_s"] / lp["steps"],
+                str(c.get("platform", "?")),
+            )
+            if line:
+                lines.append(line)
+    except Exception:  # noqa: BLE001 - a summary section must not kill summary
+        return lines
+    return lines
+
+
 def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
     out = out or sys.stdout
     head = events[0]
@@ -154,6 +248,10 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
             f"mean {_fmt_s(sum(lat) / len(lat))}",
             file=out,
         )
+
+    # roofline section: cost-analysis telemetry joined with measured time
+    for line in roofline_lines(events):
+        print(line, file=out)
 
     # timeline of notable events
     shown = 0
@@ -228,9 +326,22 @@ def cmd_check(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # the perf subcommands own their full argparse surfaces
+    # (obs/perf/{roofline,regress,merge}.main); dispatch before the ledger
+    # parser so their flags don't have to round-trip through it
+    argv_l = list(sys.argv[1:] if argv is None else argv)
+    if argv_l and argv_l[0] in ("roofline", "regress", "merge"):
+        import importlib
+
+        mod = importlib.import_module(
+            f"heat3d_tpu.obs.perf.{argv_l[0]}"
+        )
+        return mod.main(argv_l[1:])
+
     p = argparse.ArgumentParser(
         prog="heat3d obs",
-        description="inspect heat3d run ledgers (JSONL event streams)",
+        description="inspect heat3d run ledgers (JSONL event streams) and "
+        "judge performance (roofline / regress / merge — obs/perf)",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -248,7 +359,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     c.add_argument("ledgers", nargs="+")
     c.set_defaults(fn=cmd_check)
 
-    args = p.parse_args(argv)
+    # listed for --help discoverability; dispatched above before parsing
+    sub.add_parser(
+        "roofline", add_help=False,
+        help="achieved-vs-peak: per-phase cost_analysis table (live) or "
+        "the analytic row model over bench results",
+    )
+    sub.add_parser(
+        "regress", add_help=False,
+        help="perf-regression gate over bench history (pass/warn/fail)",
+    )
+    sub.add_parser(
+        "merge", add_help=False,
+        help="join per-process multihost ledgers with cross-host skew stats",
+    )
+
+    args = p.parse_args(argv_l)
     return args.fn(args)
 
 

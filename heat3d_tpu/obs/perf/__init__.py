@@ -1,0 +1,31 @@
+"""Performance observability: is a run FAST for its hardware, and did it
+regress?
+
+The base obs package (ledger / metrics / trace) makes runs *explainable*;
+this layer makes them *judged* (docs/OBSERVABILITY.md §"Performance
+observability"). Four instruments:
+
+- :mod:`~heat3d_tpu.obs.perf.profiling` — ``--profile DIR`` device-trace
+  capture on every entry point, with the artifact path and the capture
+  overhead recorded into the run ledger (a profiled run must say it was
+  profiled — capture cost is measurement perturbation).
+- :mod:`~heat3d_tpu.obs.perf.roofline` — per-config FLOPs/bytes from
+  ``compiled.cost_analysis()`` joined with per-backend peak specs:
+  ``heat3d obs roofline`` prints a per-phase achieved-vs-peak table (the
+  phases are the same ``heat3d.*`` names the named-scope spans use —
+  ``parallel.step.phase_programs`` is the keying), and the promoted
+  ``scripts/roofline_check.py`` row model lives here too.
+- :mod:`~heat3d_tpu.obs.perf.regress` — ``heat3d obs regress``: the
+  automated perf-regression gate comparing a session's bench rows against
+  committed history with per-metric tolerance bands and
+  platform/cpu_fallback-aware baselines (a CPU run never fails against a
+  TPU record).
+- :mod:`~heat3d_tpu.obs.perf.merge` — ``heat3d obs merge``: join the
+  per-process ledgers of a multihost run into one timeline with
+  cross-host skew stats.
+
+Failure posture (inherited from obs): perf telemetry never kills the run
+it observes — profiling and cost-analysis errors degrade to a ledger note.
+"""
+
+from heat3d_tpu.obs.perf.profiling import profile_capture  # noqa: F401

@@ -1,0 +1,138 @@
+"""Device-trace capture with ledger provenance.
+
+``--profile DIR`` (solver CLI, bench CLI — ``--profile-dir`` stays as the
+legacy spelling) brackets the timed region with ``jax.profiler`` trace
+capture. Capture is not free: starting the profiler takes tens of ms,
+stopping it serializes the trace to disk — both perturb the run being
+measured. So the bracket records its own cost: one ``profile_capture``
+ledger event at close carrying the artifact path (the newest
+``*.xplane.pb`` under the directory), the start/stop overhead in seconds,
+and whether capture actually engaged. A profiled bench row is then
+tellable from an unprofiled one in the post-mortem, and the overhead is
+auditable instead of silently folded into the measurement.
+
+Failure posture: a profiler that cannot start (unwritable dir, platform
+without profiler support, double-capture) must not kill the observed run —
+the bracket degrades to a no-op and the ledger event says so
+(``ok: false`` + the error). Exceptions from the BODY always propagate;
+the trace is flushed (and recorded) either way, so a crashed run still
+leaves its trace behind.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import time
+from typing import Optional
+
+
+def _newest_artifact(profile_dir: str) -> Optional[str]:
+    """The newest .xplane.pb under ``profile_dir`` (the file
+    scripts/summarize_trace.py reads), or None if capture left nothing."""
+    try:
+        files = glob.glob(
+            os.path.join(profile_dir, "**", "*.xplane.pb"), recursive=True
+        )
+        return max(files, key=os.path.getmtime) if files else None
+    except OSError:
+        return None
+
+
+def _force_reset_profiler_state() -> None:
+    """Drop jax's module-level profiler session after a FAILED stop.
+
+    ``jax.profiler.stop_trace`` clears its session only after a
+    successful export; an export that raises (e.g. the target turned out
+    not to be a directory) leaves the session set, and every LATER trace
+    in the process then dies with "Only one profile may be run at a time"
+    — one bad capture must not poison all subsequent ones. Private-API
+    touch, fully guarded: on drift this degrades to the old behavior
+    (later captures fail soft), never to a crash."""
+    try:
+        from jax._src import profiler as _profiler
+
+        with _profiler._profile_state.lock:
+            _profiler._profile_state.profile_session = None
+    except Exception:  # noqa: BLE001 - best effort only
+        pass
+
+
+class _ProfileCapture:
+    def __init__(self, profile_dir: str):
+        self.profile_dir = profile_dir
+        self._trace_cm = None
+        self._enter_s: Optional[float] = None
+
+    def __enter__(self) -> "_ProfileCapture":
+        t0 = time.perf_counter()
+        try:
+            # pre-flight the target BEFORE starting the profiler: a bad
+            # path (existing file, unwritable parent) otherwise surfaces
+            # only at stop_trace's export, wedging the process-wide
+            # profiler session (see _force_reset_profiler_state)
+            os.makedirs(self.profile_dir, exist_ok=True)
+            import jax
+
+            cm = jax.profiler.trace(self.profile_dir)
+            cm.__enter__()
+            self._trace_cm = cm
+        except Exception as e:  # noqa: BLE001 - capture must fail soft
+            self._error = f"{type(e).__name__}: {str(e)[:200]}"
+            self._trace_cm = None
+        else:
+            self._error = None
+        self._enter_s = time.perf_counter() - t0
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t0 = time.perf_counter()
+        if self._trace_cm is not None:
+            try:
+                # flush with clean (None) args even when the body raised:
+                # the profiler context must not mask the body's exception,
+                # and a failed run's trace is exactly the one worth keeping
+                self._trace_cm.__exit__(None, None, None)
+            except Exception as e:  # noqa: BLE001 - flush fails soft too
+                self._error = f"{type(e).__name__}: {str(e)[:200]}"
+                self._trace_cm = None
+                _force_reset_profiler_state()
+        exit_s = time.perf_counter() - t0
+        from heat3d_tpu import obs
+
+        fields = {
+            "dir": self.profile_dir,
+            "ok": self._trace_cm is not None,
+            "enter_overhead_s": self._enter_s,
+            "exit_overhead_s": exit_s,
+        }
+        artifact = _newest_artifact(self.profile_dir)
+        if artifact is not None:
+            fields["artifact"] = artifact
+        if self._error is not None:
+            fields["error"] = self._error
+            import sys
+
+            print(
+                f"heat3d: profile capture to {self.profile_dir} degraded "
+                f"({self._error}); run continues unprofiled",
+                file=sys.stderr,
+            )
+        obs.get().event("profile_capture", **fields)
+        obs.REGISTRY.gauge(
+            "profile_capture_overhead_seconds",
+            "profiler start+stop cost around the traced region",
+        ).set(self._enter_s + exit_s, ok=str(fields["ok"]).lower())
+        return False  # never swallow the body's exception
+
+
+def profile_capture(profile_dir: Optional[str]):
+    """The one profiler bracket every entry point wraps its timed region
+    in (``utils.timing.maybe_profile`` delegates here): ``jax.profiler``
+    trace capture into ``profile_dir`` + a ``profile_capture`` ledger
+    event recording artifact path and capture overhead. A falsy dir is a
+    plain no-op context."""
+    if not profile_dir:
+        return contextlib.nullcontext()
+    return _ProfileCapture(profile_dir)

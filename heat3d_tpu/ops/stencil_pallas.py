@@ -379,8 +379,10 @@ def _stream2_kernel(
     bc = compute_dtype(bc_value)
 
     def edges(axis_name):
+        from heat3d_tpu.utils.compat import axis_size
+
         idx = jax.lax.axis_index(axis_name)
-        size = jax.lax.axis_size(axis_name)
+        size = axis_size(axis_name)
         return idx == 0, idx == size - 1
 
     for k in range(3):

@@ -1090,3 +1090,111 @@ def make_converge_fn(
         return u, steps, jnp.sqrt(r2)
 
     return run
+
+
+# ---- span <-> cost-analysis keying ------------------------------------------
+
+# The named_phase brackets above (obs/trace.py), the profiler trace's
+# per-phase table (scripts/summarize_trace.py), the ledger spans, and the
+# per-phase compile targets below all share these names — a cost_analysis()
+# record joins a measured span on ONE key (obs/perf/roofline.py consumes).
+PHASE_STEP = "step"
+PHASE_STENCIL = "stencil"
+PHASE_HALO = "halo_exchange"
+PHASE_FUSED = "fused_dma"
+PHASE_RESIDUAL = "residual"
+
+
+def phase_programs(
+    cfg: SolverConfig,
+    mesh: Mesh,
+    compute_padded: LocalCompute = apply_taps_padded,
+):
+    """Un-jitted compile targets per phase, each a callable over the
+    sharded global field (storage layout, ``cfg.padded_shape``):
+
+    - ``step``: the full iteration program this config's hot loop runs —
+      the single step (exchange + stencil [+ padding pin]) at
+      ``time_blocking == 1``, the k-update SUPERSTEP at k > 1 (one
+      exchange amortized over k updates, ghost-ring recompute included;
+      costing the single step there would describe a program the loop
+      never runs). Costs and timings are per CALL — at k > 1 one call is
+      k updates; divide by k for per-update numbers
+      (``obs.perf.roofline.step_cost_fields`` does).
+    - ``halo_exchange``: the ghost exchange alone (whichever transport
+      ``cfg.halo`` selects), cropped back to the local block so the
+      program has a data-live consumer of every received face.
+    - ``stencil``: the local tap application alone on locally-padded
+      blocks (no collective) — the compute leg of the roofline.
+    - ``residual``: the fp32 reduction + psum alone.
+    - ``fused_dma``: only when this config resolves to a fused DMA-overlap
+      route, where exchange+stencil are ONE kernel and per-leg programs
+      would misattribute: the full step program is the honest program for
+      the span of the same name.
+
+    Callers jit + ``.lower(u).compile().cost_analysis()`` each to get the
+    FLOPs/bytes the roofline report divides measured span time by.
+    """
+    taps = _solver_taps(cfg)
+    spec = P(*cfg.mesh.axis_names)
+    compute_dtype = jnp.dtype(cfg.precision.compute)
+    out_dtype = jnp.dtype(cfg.precision.storage)
+
+    def _sharded(f, out_specs=spec):
+        return shard_map(
+            f, mesh=mesh, in_specs=spec, out_specs=out_specs, check_vma=False
+        )
+
+    def _halo_only(u_local):
+        # every received ghost face is folded onto the block boundary
+        # (face-sized writes, the same keep-alive trick bench_halo uses) so
+        # XLA cannot DCE any of the six transports out of the program
+        nx, ny, nz = u_local.shape
+        p = exchange(u_local, cfg)
+        out = u_local
+        out = out.at[0].add(p[0, 1 : 1 + ny, 1 : 1 + nz])
+        out = out.at[nx - 1].add(p[nx + 1, 1 : 1 + ny, 1 : 1 + nz])
+        out = out.at[:, 0].add(p[1 : 1 + nx, 0, 1 : 1 + nz])
+        out = out.at[:, ny - 1].add(p[1 : 1 + nx, ny + 1, 1 : 1 + nz])
+        out = out.at[:, :, 0].add(p[1 : 1 + nx, 1 : 1 + ny, 0])
+        out = out.at[:, :, nz - 1].add(p[1 : 1 + nx, 1 : 1 + ny, nz + 1])
+        return out
+
+    def _stencil_only(u_local):
+        with named_phase("stencil"):
+            return compute_padded(
+                jnp.pad(u_local, 1),  # local ghost fill: no collective
+                taps,
+                compute_dtype=compute_dtype,
+                out_dtype=out_dtype,
+            )
+
+    def _residual_only(u_local):
+        with named_phase("residual"):
+            r = residual_sumsq(
+                u_local, u_local * 1, jnp.dtype(cfg.precision.residual)
+            )
+            return lax.psum(r, cfg.mesh.axis_names)
+
+    programs = {
+        PHASE_STEP: (
+            make_superstep_fn(cfg, mesh, compute_padded)
+            if cfg.time_blocking > 1
+            else make_step_fn(cfg, mesh, compute_padded)
+        ),
+        PHASE_HALO: _sharded(_halo_only),
+        PHASE_STENCIL: _sharded(_stencil_only),
+        PHASE_RESIDUAL: _sharded(_residual_only, out_specs=P()),
+    }
+    fused = (
+        (_fused_dma2_fn(cfg) is not None)
+        if cfg.time_blocking == 2
+        else (
+            _fused_dma_fn(cfg) is not None or _fused_dma_3d_fn(cfg) is not None
+        )
+        if cfg.time_blocking == 1
+        else False
+    )
+    if fused:
+        programs[PHASE_FUSED] = programs[PHASE_STEP]
+    return programs

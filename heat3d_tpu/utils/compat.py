@@ -45,6 +45,23 @@ else:  # jax 0.4.x: experimental module, check_vma spelled check_rep
         )
 
 
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` across versions: the top-level export where it
+    exists, else derived from the axis environment (jax 0.4.x has no
+    ``lax.axis_size``; ``core.axis_frame(name)`` there returns the bound
+    size directly). Trace-time only — resolves to a Python int under
+    shard_map, including inside Pallas kernels (no collective is emitted,
+    unlike the ``psum(1, name)`` idiom)."""
+    import jax.lax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax._src import core
+
+    frame = core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)
+
+
 def make_abstract_mesh(shape, axis_names):
     """``jax.sharding.AbstractMesh`` across its two constructor signatures:
     ``AbstractMesh(axis_sizes, axis_names)`` (current) vs the 0.4.x

@@ -144,13 +144,28 @@ def percentile(values: List[float], q: float) -> float:
 
 
 def maybe_profile(profile_dir):
-    """``jax.profiler.trace`` context for ``profile_dir``, or a no-op
-    context without one — the single profiler bracket every entry point
-    (solver CLI, bench CLI, supervised runs) wraps its timed region in."""
+    """The single profiler bracket every entry point (solver CLI, bench
+    CLI, supervised runs) wraps its timed region in. Delegates to
+    ``obs.perf.profiling.profile_capture``: ``jax.profiler`` trace capture
+    plus a ``profile_capture`` ledger event recording the artifact path
+    and the capture overhead — and capture failures degrade to an
+    unprofiled run instead of killing it. A falsy dir is a no-op
+    context. An import failure in the perf package degrades to an
+    unprofiled run (one stderr note) — capture is telemetry and must
+    never kill the entry point wrapping it."""
     import contextlib
 
     if not profile_dir:
         return contextlib.nullcontext()
-    import jax
+    try:
+        from heat3d_tpu.obs.perf.profiling import profile_capture
+    except Exception as e:  # noqa: BLE001 - telemetry fails soft
+        import sys
 
-    return jax.profiler.trace(profile_dir)
+        print(
+            f"heat3d: profile capture unavailable ({e}); "
+            "run continues unprofiled",
+            file=sys.stderr,
+        )
+        return contextlib.nullcontext()
+    return profile_capture(profile_dir)

@@ -1,227 +1,24 @@
-"""Compare measured throughput rows against the HBM traffic-model ceilings
-and a VPU op-cost model of the tap chain.
+"""Thin wrapper: the roofline row model now lives in
+``heat3d_tpu/obs/perf/roofline.py`` (the ``heat3d obs roofline`` CLI),
+promoted there so the analytic traffic/op-cost model and the
+cost-analysis-based per-phase attribution share one module. This script
+keeps the historical invocation working:
 
-Reads a bench_results.jsonl (bench.harness rows) and prints, per throughput
-row, the step path it ran, its bytes/cell/update, the bandwidth ceiling at
-the given HBM rate, the vector-op count of the emitted tap chain (and the
-VPU ceiling when ``--vpu-gops`` is given), and the achieved fraction of the
-binding ceiling — the "where did the rest go" accounting BASELINE.md's
-traffic model sets up.
+    python scripts/roofline_check.py bench_results.jsonl
+        [--hbm-gbps 819] [--vpu-gops N] [--fit]
 
-The op count comes from :func:`heat3d_tpu.core.stencils.effective_num_taps`
-driving the REAL accumulate_taps emission under the current factoring env
-(HEAT3D_FACTOR_Y / HEAT3D_FACTOR_7PT) — so the printed chain cost is the
-one the rows actually compiled *if* the env matches the measurement run
-(each FMA term and each cached plane/row sum counts as one full-volume
-vector op; kernel plane-assembly overhead is not modeled). ``--vpu-gops``
-has no trustworthy public per-chip number; calibrate it from a measured
-compute-bound row (e.g. 27pt tb=1: gops ≈ ops/cell x measured Gcell/s)
-and then use it to sanity-check the OTHER compute-bound rows.
-
-Usage: python scripts/roofline_check.py bench_results.jsonl
-           [--hbm-gbps 819] [--vpu-gops N]
+Same flags, same output (see the module docstring there for the model's
+semantics and the --vpu-gops calibration rule).
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def bytes_per_cell_update(row) -> tuple[float, str]:
-    """Traffic model per path (BASELINE.md 'HBM traffic model')."""
-    item = 2 if row["dtype"] == "bfloat16" else 4
-    tb = row.get("time_blocking", 1)
-    mesh = row.get("mesh", [1, 1, 1])
-    single = all(m == 1 for m in mesh)
-    halo = row.get("halo", "ppermute")
-    overlap = row.get("overlap", False)
-    # the direct kernels apply on unpadded shards for ppermute transport;
-    # DMA transport and tb>2 keep the padded exchange (one extra volume
-    # read+write per exchange). Prefer the RESOLVED selection the harness
-    # recorded (exact even for HEAT3D_NO_DIRECT A/B rows); derive for
-    # legacy rows.
-    if row.get("fused_dma_path"):
-        # fused DMA-overlap kernels: unpadded streaming sweep, one
-        # read+write per sweep of tb updates — same traffic shape as the
-        # direct kernels
-        return 2 * item / tb, f"fused-dma{'' if tb == 1 else '2'}"
-    direct = row.get("direct_path")
-    if direct is None:
-        direct = halo == "ppermute" and tb in (1, 2)
-    if direct and not (overlap and tb == 2):
-        per_update = 2 * item / tb  # one read + one write per sweep of tb
-        path = f"direct{'' if tb == 1 else '2'}{'' if single else '+faces'}"
-    else:
-        # exchange path: padded copy (r+w) once per exchange + sweep per
-        # update (tb updates share one exchange)
-        per_update = 2 * item + 2 * item / tb
-        path = f"exchange(tb={tb})"
-    return per_update, path
-
-
-def vpu_ops_per_cell_update(row) -> int:
-    """Vector ops/cell/update of the row's tap chain. Prefers the
-    ``chain_ops`` the harness recorded at measurement time (exact even for
-    factoring-knob A/B rows); falls back to re-deriving under the CURRENT
-    factoring env for rows predating that field. Tap VALUES don't matter
-    for the count, only which offsets are nonzero, so nominal
-    alpha/dt/spacing are fine for the fallback."""
-    if "chain_ops" in row:
-        return row["chain_ops"]  # may be None: conv rows run no tap chain
-    if row.get("backend") == "conv":
-        return None
-    from heat3d_tpu.core.stencils import chain_ops_for
-
-    return chain_ops_for(row.get("stencil", "7pt"))
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("results", nargs="+",
-                    help="one or more row files (bench_results.jsonl plus "
-                    "e.g. A/B rows extracted from tpu_measure.log — the "
-                    "factoring A/B stages log their rows rather than "
-                    "appending them to the suite record)")
-    ap.add_argument("--hbm-gbps", type=float, default=819.0,
-                    help="chip HBM bandwidth (GB/s); v5e ~819, v5p ~2765")
-    ap.add_argument("--vpu-gops", type=float, default=None,
-                    help="VPU vector throughput (Gop/s, one op = one "
-                    "full-width FMA or add); calibrate from a measured "
-                    "compute-bound row — no default on purpose")
-    ap.add_argument("--fit", action="store_true",
-                    help="per (grid, dtype, tb, path) group with >=2 "
-                    "distinct chain_ops values, fit time/cell/update = "
-                    "a + b*ops: linearity in ops IS the compute-bound "
-                    "evidence, 1/b the marginal VPU rate, a the per-cell "
-                    "fixed cost (loads/stores/plane assembly)")
-    args = ap.parse_args()
-
-    rows = []
-    for results in args.results:
-        with open(results) as f:
-            for line in f:
-                # tolerate log-style prefixes ("factor_y=0 tb=1: {...}")
-                line = line.strip()
-                brace = line.find("{")
-                if brace < 0:
-                    continue
-                try:
-                    r = json.loads(line[brace:])
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(r, dict) and r.get("bench") == "throughput":
-                    rows.append(r)
-    if not rows:
-        print("no throughput rows found", file=sys.stderr)
-        return 1
-
-    print(f"{'grid':>6} {'dtype':>8} {'st':>4} {'tb':>2} {'path':>16} "
-          f"{'B/cell/upd':>10} {'ops':>4} {'ceiling':>9} {'bind':>4} "
-          f"{'measured':>9} {'achieved':>8}")
-    for r in rows:
-        per_update, path = bytes_per_cell_update(r)
-        bw_ceiling = args.hbm_gbps / per_update  # Gcell/s/chip
-        ops = vpu_ops_per_cell_update(r)
-        ceiling, bind = bw_ceiling, "hbm"
-        # ops is None for conv rows (one XLA conv op, no tap chain): the
-        # VPU model doesn't apply — report against the HBM ceiling only
-        if args.vpu_gops is not None and ops is not None:
-            vpu_ceiling = args.vpu_gops / ops
-            if vpu_ceiling < bw_ceiling:
-                ceiling, bind = vpu_ceiling, "vpu"
-        meas = r["gcell_per_sec_per_chip"]
-        grid = r["grid"][0] if len(set(r["grid"])) == 1 else "x".join(
-            map(str, r["grid"]))
-        flag = " (RTT!)" if r.get("rtt_dominated") else ""
-        # compute dtype doesn't change HBM traffic (storage dtype does),
-        # but label it so bf16-compute A/B rows are tellable apart
-        if r.get("compute_dtype", "float32") != "float32":
-            flag = " (c=bf16)" + flag
-        print(f"{grid:>6} {r['dtype']:>8} {r.get('stencil', '7pt'):>4} "
-              f"{r.get('time_blocking', 1):>2} {path:>16} "
-              f"{per_update:>10.1f} {'n/a' if ops is None else ops:>4} "
-              f"{ceiling:>9.1f} {bind:>4} "
-              f"{meas:>9.2f} {meas / ceiling:>7.1%}{flag}")
-
-    if args.fit:
-        _fit_op_cost(rows)
-    return 0
-
-
-def _fit_op_cost(rows) -> None:
-    """Least-squares time/cell/update = a + b*ops over rows that differ
-    ONLY in their emitted chain (same grid/dtype/tb/path). A good linear
-    fit is direct evidence the kernels are compute-bound in chain ops;
-    a >> b would instead indict fixed per-cell cost (assembly/shifts)."""
-    from collections import defaultdict
-
-    groups = defaultdict(list)
-    for r in rows:
-        if r.get("rtt_dominated"):
-            continue
-        _, path = bytes_per_cell_update(r)
-        # compute_dtype/backend in the key: a bf16-compute A/B row has the
-        # same chain_ops as its fp32-compute twin but different per-op
-        # cost — pooling them would corrupt the fit silently
-        key = (
-            tuple(r["grid"]), r["dtype"],
-            r.get("compute_dtype", "float32"), r.get("backend", "auto"),
-            r.get("time_blocking", 1), path,
-        )
-        ops = vpu_ops_per_cell_update(r)
-        if ops is None:
-            continue  # conv rows: no tap chain, nothing to fit against
-        ns_per_cell = 1.0 / r["gcell_per_sec_per_chip"]  # ns/cell/update
-        groups[key].append((ops, ns_per_cell))
-    printed = False
-    for key, pts in sorted(groups.items()):
-        by_ops = {}
-        for ops, t in pts:
-            by_ops.setdefault(ops, []).append(t)
-        if len(by_ops) < 2:
-            continue
-        xs, ys = zip(*((o, min(ts)) for o, ts in sorted(by_ops.items())))
-        n = len(xs)
-        mx, my = sum(xs) / n, sum(ys) / n
-        sxx = sum((x - mx) ** 2 for x in xs)
-        b = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / sxx
-        a = my - b * mx
-        if n >= 3:
-            ss_res = sum((y - (a + b * x)) ** 2 for x, y in zip(xs, ys))
-            ss_tot = sum((y - my) ** 2 for y in ys) or 1e-30
-            fit_q = f"R^2={1 - ss_res / ss_tot:.3f}"
-        else:
-            # a line through 2 points always "fits"; don't dress that up
-            fit_q = "2-point (no linearity evidence)"
-        grid, dtype, cdtype, backend, tb, path = key
-        cflag = "" if cdtype == "float32" else f" c={cdtype}"
-        glabel = (f"{grid[0]}^3" if len(set(grid)) == 1
-                  else "x".join(map(str, grid)))
-        if b <= 0:
-            # higher-ops rows timed FASTER: noise or a confound — that's
-            # anti-evidence of compute-boundedness, not an infinite rate
-            verdict = "non-positive slope — unfittable/not compute-bound"
-        else:
-            verdict = (
-                f"marginal {1.0 / b:.0f} Gop/s, "
-                f"fixed {a / (a + b * xs[0]):.0%} of the {xs[0]}-op chain"
-            )
-        print(
-            f"\nfit {glabel} {dtype}{cflag} tb={tb} {path}: "
-            f"t/cell = {a:.3f} + {b:.4f}*ops ns "
-            f"({verdict}, {fit_q}, points={list(by_ops)})"
-        )
-        printed = True
-    if not printed:
-        print("\nfit: no group has >=2 distinct chain_ops values "
-              "(need factoring A/B rows, e.g. HEAT3D_FACTOR_Y=0)",
-              file=sys.stderr)
-
+from heat3d_tpu.obs.perf.roofline import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

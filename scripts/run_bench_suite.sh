@@ -219,11 +219,24 @@ fi
 # session whose every row skipped leaves the committed tables untouched
 python -m heat3d_tpu.bench.report "$OUT" "$REPORT_MD"
 
-# Lints LAST (after the report, so failing them never loses the tables):
-# provenance — rc 1 if any row THIS SESSION wrote has ts null/missing,
-# lacks its route fields, or lacks sync_rtt_s (VERDICT r5 weak item 2,
-# enforced going forward); ledger — rc 1 if the session's event stream is
-# schema-invalid (missing fields, broken span nesting, torn run-ids).
-# Their rc is the suite's rc under set -e.
+# Roofline attribution of the session's rows (informational: achieved
+# fraction of the traffic-model ceiling per row — the "where did the rest
+# go" accounting; its rc must not gate the suite, a reporting bug loses
+# nothing)
+python -m heat3d_tpu.obs.cli roofline "$OUT" \
+  || note "suite: roofline report failed (rc=$?)"
+
+# Lints + the perf gate LAST (after the report, so failing them never
+# loses the tables): provenance — rc 1 if any row THIS SESSION wrote has
+# ts null/missing, lacks its route fields, or lacks sync_rtt_s (VERDICT
+# r5 weak item 2, enforced going forward); ledger — rc 1 if the session's
+# event stream is schema-invalid (missing fields, broken span nesting,
+# torn run-ids); regress — rc 1 if any row this session measured regressed
+# past the fail band against the committed same-platform history
+# (platform-aware baselines: CPU smoke rows never compare against TPU
+# records — they report no_baseline and pass). Their rc is the suite's rc
+# under set -e; the regress JSON verdict also lands in the suite log.
 python scripts/check_provenance.py --start-line "$LINT_FROM" "$OUT"
 python scripts/check_ledger.py --start-line "$LEDGER_LINT_FROM" "$LEDGER"
+python -m heat3d_tpu.obs.cli regress "$OUT" --start-line "$LINT_FROM" \
+  --json | tee -a "$SUITE_LOG"
