@@ -46,6 +46,14 @@ def main(argv=None) -> int:
     obs.activate(args.ledger, meta={"entry": "bench", "bench": args.bench})
     try:
         cfg = config_from_args(args)
+        # tuning-cache resolution of the auto knobs, HERE at the entry
+        # point: measured rows must record the CONCRETE route (a row with
+        # halo='auto' would corrupt the regression gate's config keys and
+        # the roofline traffic model), and bench_halo exercises the
+        # transport without ever building a solver
+        from heat3d_tpu.tune.cache import resolve_config
+
+        cfg = resolve_config(cfg)
         profile_cm = maybe_profile(args.profile_dir)
         profile_cm.__enter__()
         try:

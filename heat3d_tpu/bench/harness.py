@@ -151,6 +151,7 @@ def bench_throughput(
         "time_blocking": cfg.time_blocking,
         "overlap": cfg.overlap,
         "halo": cfg.halo,
+        "halo_order": cfg.halo_order,
         "steps": steps,
         "steps_requested": steps_requested,
         "seconds_best": best,
@@ -267,17 +268,12 @@ def _mehrstellen_route(cfg: SolverConfig) -> bool:
     # from what executes
     if decompose_mehrstellen(_solver_taps(cfg)) is None:
         return False
-    backend = cfg.backend
-    if backend == "auto":
-        # the solver's own resolution (models.heat3d._select_backend):
-        # auto falls back to the jnp apply whenever the Pallas kernels
-        # can't run this config — in which case the route DOES execute
-        try:
-            from heat3d_tpu.ops.stencil_pallas import pallas_supported
+    # the solver's own resolution (models.heat3d.resolved_backend_name):
+    # auto falls back to the jnp apply whenever the Pallas kernels
+    # can't run this config — in which case the route DOES execute
+    from heat3d_tpu.models.heat3d import resolved_backend_name
 
-            backend = "pallas" if pallas_supported(cfg)[0] else "jnp"
-        except ImportError:
-            backend = "jnp"
+    backend = resolved_backend_name(cfg)
     if backend == "jnp":
         return True
     return cfg.time_blocking in (1, 2) and _resolved_direct(cfg)
@@ -389,6 +385,7 @@ def bench_halo(
         "grid": list(cfg.grid.shape),
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
+        "halo_order": cfg.halo_order,
         "iters": iters,
         "exchanges_per_program": k,
         "p50_us": percentile(times, 50) * 1e6,
@@ -491,7 +488,10 @@ def run_suite(
             row_key(cfg, "throughput"),
             lambda cfg=cfg: bench_throughput(cfg, steps=steps),
         )
-        halo_key = (cfg.grid.shape, cfg.mesh.shape, cfg.precision.storage, cfg.halo)
+        halo_key = (
+            cfg.grid.shape, cfg.mesh.shape, cfg.precision.storage,
+            cfg.halo, cfg.halo_order,
+        )
         if halo_key not in halo_seen:
             halo_seen.add(halo_key)
             one_row(row_key(cfg, "halo"), lambda cfg=cfg: bench_halo(cfg))

@@ -86,14 +86,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap", action="store_true",
                    help="overlap halo exchange with interior compute "
                    "(interior/boundary split step)")
-    p.add_argument("--halo", choices=["ppermute", "dma"], default="ppermute",
-                   help="ghost-exchange transport: XLA collective-permute or "
-                   "Pallas remote-DMA kernels (TPU only)")
+    p.add_argument("--halo", choices=["ppermute", "dma", "auto"],
+                   default="ppermute",
+                   help="ghost-exchange transport: XLA collective-permute, "
+                   "Pallas remote-DMA kernels (TPU only), or 'auto' — "
+                   "resolve through the tuning cache (heat3d tune; "
+                   "docs/TUNING.md) with a ppermute fallback")
+    p.add_argument("--halo-order", choices=["axis", "pairwise"],
+                   default="axis",
+                   help="halo-exchange ordering: 'axis' (x->y->z, "
+                   "corner-propagating — required by 27pt) or 'pairwise' "
+                   "(all six face permutes concurrent, stagger-tolerant; "
+                   "7pt only — the tuner A/Bs the two)")
     p.add_argument("--time-blocking", type=int, default=1,
                    help="stencil updates per ghost exchange in the "
                    "fixed-step loop (k>1 = temporal blocking: width-k "
                    "halos, 1/k the messages; k=2 also fuses both updates "
-                   "into one HBM sweep; convergence mode --tol checks the "
+                   "into one HBM sweep; k=0 = auto via the tuning cache; "
+                   "convergence mode --tol checks the "
                    "residual every step and always runs single updates)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--init", default="hot-cube", help="hot-cube | gaussian | random")
@@ -190,6 +200,7 @@ def config_from_args(args) -> SolverConfig:
         overlap=args.overlap,
         halo=args.halo,
         time_blocking=args.time_blocking,
+        halo_order=args.halo_order,
     )
 
 
@@ -202,6 +213,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from heat3d_tpu.obs.cli import main as obs_main
 
         return obs_main(argv_l[1:])
+    # `heat3d tune ...` — the autotuner surface (run/show/apply/clear/lint),
+    # dispatched the same way as `obs` (docs/TUNING.md)
+    if argv_l and argv_l[0] == "tune":
+        from heat3d_tpu.tune.cli import main as tune_main
+
+        return tune_main(argv_l[1:])
     # A measurement script stopping this run with `timeout` (SIGTERM) must
     # release the axon pool's chip claim on the way out, not die holding it.
     from heat3d_tpu.utils.backendprobe import install_sigterm_exit
@@ -234,6 +251,14 @@ def _main(argv: Optional[List[str]] = None) -> int:
     # on a bad config still leaves a ledger_open + rc=2 close)
     ledger = obs.activate(args.ledger, meta={"entry": "solve"})
     cfg = config_from_args(args)
+    # tuning-cache resolution of the auto knobs (backend='auto',
+    # halo='auto', time_blocking=0) BEFORE run_start, so the ledger
+    # records the config that actually runs; the hit/miss/stale event
+    # lands just above it (heat3d_tpu.tune.cache — fails soft to the
+    # static defaults, never the run)
+    from heat3d_tpu.tune.cache import resolve_config
+
+    cfg = resolve_config(cfg)
     ledger.event(
         "run_start",
         grid=list(cfg.grid.shape),
@@ -242,6 +267,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
         dtype=cfg.precision.storage,
         backend=cfg.backend,
         halo=cfg.halo,
+        halo_order=cfg.halo_order,
         overlap=cfg.overlap,
         time_blocking=cfg.time_blocking,
         steps=cfg.run.num_steps,

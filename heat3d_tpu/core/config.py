@@ -202,23 +202,64 @@ class SolverConfig:
     # TPU analogue of the reference class's two-stream interior/boundary
     # overlap (SURVEY.md §3.2, §7.3 item 2). Needs local blocks >= 3 per axis.
     overlap: bool = False
-    # Ghost-exchange transport: 'ppermute' (XLA collective-permute, v1) or
+    # Ghost-exchange transport: 'ppermute' (XLA collective-permute, v1),
     # 'dma' (Pallas make_async_remote_copy kernels — the CUDA-aware/GPUDirect
-    # analogue, SURVEY.md §7.1 item 7; TPU only).
+    # analogue, SURVEY.md §7.1 item 7; TPU only), or 'auto' (resolve
+    # through the tuning cache — heat3d_tpu.tune — with a 'ppermute'
+    # static fallback when no cache entry matches; docs/TUNING.md).
     halo: str = "ppermute"
     # Updates per ghost exchange in the fixed-step loop (temporal blocking):
     # k > 1 exchanges width-k halos and applies the stencil k times per
     # superstep, cutting ICI messages k-fold; k == 2 additionally fuses both
-    # applications into one HBM sweep via a Pallas kernel.
+    # applications into one HBM sweep via a Pallas kernel. k == 0 means
+    # "auto": resolve through the tuning cache (static fallback 1).
     time_blocking: int = 1
+    # Halo-exchange ordering: 'axis' (x -> y -> z, each axis operating on
+    # the array already padded by previous axes — propagates edge/corner
+    # ghosts, required by the 27-point stencil) or 'pairwise' (all six
+    # face ppermutes issued concurrently from the RAW boundary faces; no
+    # cross-axis data dependence, so a cross-host start skew of one
+    # exchange latency cannot serialize the axes — the stagger-tolerant
+    # ordering, ROADMAP "skew-aware halo tuning"). Pairwise fills corner
+    # ghosts with the BC value, so it is only valid for stencils that
+    # never read them (7pt) at time_blocking <= 1 on the ppermute
+    # transport; the tuner A/Bs the two orderings.
+    halo_order: str = "axis"
 
     def __post_init__(self):
-        if self.halo not in ("ppermute", "dma"):
+        if self.halo not in ("ppermute", "dma", "auto"):
             raise ValueError(f"unknown halo transport {self.halo!r}")
-        if self.time_blocking < 1:
+        if self.time_blocking < 0:
             raise ValueError(
-                f"time_blocking must be >= 1, got {self.time_blocking}"
+                f"time_blocking must be >= 1 (or 0 = auto via the tuning "
+                f"cache), got {self.time_blocking}"
             )
+        if self.halo_order not in ("axis", "pairwise"):
+            raise ValueError(
+                f"unknown halo_order {self.halo_order!r} (want axis|pairwise)"
+            )
+        if self.halo_order == "pairwise":
+            # pairwise ordering leaves corner/edge ghosts at bc_value:
+            # exactly the cells the 27pt stencil and the temporally-blocked
+            # ring recompute read — reject instead of silently corrupting
+            if self.stencil.kind != "7pt":
+                raise ValueError(
+                    f"halo_order='pairwise' needs a face-only stencil "
+                    f"(7pt); {self.stencil.kind} reads the corner ghosts "
+                    "only axis-ordered exchange propagates"
+                )
+            if self.time_blocking not in (0, 1):
+                raise ValueError(
+                    "halo_order='pairwise' needs time_blocking <= 1: the "
+                    "superstep's shrinking ghost rings read edge cells "
+                    "only axis-ordered exchange fills"
+                )
+            if self.halo == "dma":
+                raise ValueError(
+                    "halo_order='pairwise' applies to the ppermute "
+                    "transport; the DMA exchange kernels implement "
+                    "axis-ordered propagation"
+                )
         if self.is_padded and self.stencil.bc is BoundaryCondition.PERIODIC:
             raise ValueError(
                 f"grid {self.grid.shape} is not divisible by mesh "

@@ -42,6 +42,22 @@ def _device_init_enabled() -> bool:
     )
 
 
+def resolved_backend_name(cfg: SolverConfig) -> str:
+    """The concrete backend NAME this config's compute resolves to —
+    ``_select_backend``'s 'auto' rule (pallas where supported, else jnp)
+    as a name instead of a callable, so consumers that must RECORD the
+    route (the tuner's cache entries, provenance fields) share the one
+    rule instead of re-implementing it."""
+    if cfg.backend != "auto":
+        return cfg.backend
+    try:
+        from heat3d_tpu.ops.stencil_pallas import pallas_supported
+
+        return "pallas" if pallas_supported(cfg)[0] else "jnp"
+    except ImportError:
+        return "jnp"
+
+
 def _select_backend(cfg: SolverConfig):
     """Resolve the compute backend to a padded-block compute callable.
 
@@ -50,7 +66,8 @@ def _select_backend(cfg: SolverConfig):
     'conv'   — one XLA conv_general_dilated (MXU on TPU) — the measured
                A/B reference for what the chains/kernels buy.
     'auto'   — pallas on TPU when the local block meets the kernel's layout
-               constraints, else jnp.
+               constraints, else jnp (``resolved_backend_name`` is the
+               name-returning form of this rule).
     """
     from heat3d_tpu.ops.stencil_jnp import apply_taps_conv_padded, apply_taps_padded
 
@@ -109,6 +126,25 @@ class HeatSolver3D:
     """
 
     def __init__(self, cfg: SolverConfig, devices=None):
+        # Auto knobs (backend='auto', halo='auto', time_blocking=0)
+        # resolve through the tuning cache — the safety net for library
+        # users; the CLIs resolve at their entry points so their rows and
+        # run_start events record concrete routes. resolve_config fails
+        # soft; the belt-and-braces fallback below covers even an
+        # unimportable tune package (the solver must never require it).
+        try:
+            from heat3d_tpu.tune.cache import resolve_config
+
+            cfg = resolve_config(cfg)
+        except Exception:  # noqa: BLE001 - resolution is optional
+            if cfg.halo == "auto" or cfg.time_blocking == 0:
+                cfg = dataclasses.replace(
+                    cfg,
+                    halo="ppermute" if cfg.halo == "auto" else cfg.halo,
+                    time_blocking=(
+                        1 if cfg.time_blocking == 0 else cfg.time_blocking
+                    ),
+                )
         if cfg.halo == "dma":
             platform = jax.devices()[0].platform
             # The fused DMA-overlap routes (overlap=True) have an off-TPU

@@ -46,6 +46,14 @@ NOTABLE = (
     "run_summary",
     "metrics_summary",
     "bench_row",
+    "tune_search_start",
+    "tune_trial",
+    "tune_winner",
+    "tune_budget_exhausted",
+    "tune_cache_hit",
+    "tune_cache_miss",
+    "tune_cache_stale",
+    "peak_calibrated",
     "run_end",
     "ledger_close",
 )
@@ -265,6 +273,8 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
                 "kind_", "step", "steps", "steps_done", "generation",
                 "resumed_from", "stop_reason", "attempts", "fault", "path",
                 "reason", "status", "bench", "grid", "ok",
+                "key", "knobs", "applied", "speedup_vs_default",
+                "vector_gflops",
             )
             if k in r
         ]

@@ -27,6 +27,13 @@ Baseline rules (each one exists because a naive diff lied once):
   row would wave regressions through.
 - **RTT-honest**: ``rtt_dominated`` rows (current or baseline) are
   excluded — their numbers are link artifacts, not measurements.
+- **Age-windowed on request**: ``--window N`` limits the baseline pool to
+  the last N measurement sessions (distinct UTC measurement dates from
+  row ``ts`` provenance), so an ancient best row that stopped being
+  reproducible can age out (ROADMAP "regression-gate history hygiene").
+- **Tune-aware**: the report lists tuning-cache entries that flipped a
+  default knob (``tuned_configs``), so a throughput shift coinciding
+  with an autotuned route change is explainable from the verdict alone.
 
 Tolerance bands are per-metric percentages: a drop worse than
 ``--fail-pct`` (default 15) fails, worse than ``--warn-pct`` (default 8)
@@ -80,6 +87,7 @@ def row_key(row: Dict[str, Any]) -> Optional[Tuple]:
             row.get("time_blocking", 1),
             bool(row.get("overlap")),
             row.get("halo", "ppermute"),
+            row.get("halo_order", "axis"),
             row.get("backend", "auto"),
             _platform_class(row),
         )
@@ -90,6 +98,7 @@ def row_key(row: Dict[str, Any]) -> Optional[Tuple]:
             tuple(row.get("mesh") or ()),
             row.get("dtype"),
             row.get("halo", "ppermute"),
+            row.get("halo_order", "axis"),
             _platform_class(row),
         )
     if bench == "driver":
@@ -266,6 +275,96 @@ def compare(
     }
 
 
+def filter_window(
+    rows: List[Dict[str, Any]], window: Optional[int]
+) -> List[Dict[str, Any]]:
+    """History limited to the last ``window`` measurement SESSIONS, where
+    a session is a distinct UTC measurement date (the ``ts`` provenance
+    field every post-PR-2 row carries). ``window`` None/0 keeps
+    everything — the historical best-of-history behavior. Rows WITHOUT a
+    parseable ``ts`` (pre-provenance rows, driver-artifact pseudo-rows)
+    are excluded when a window is active: a baseline whose age cannot be
+    established cannot be shown to be inside it — exactly the
+    "ancient best row stops being reproducible" hygiene this knob exists
+    for (ROADMAP: regression-gate history hygiene). Sessions are counted
+    PER PLATFORM CLASS: two recent CPU debug sessions must not evict the
+    TPU baseline pool (which the platform-aware keying exists to protect
+    — windowing before it would disarm it). A negative window is a
+    caller bug, not a slicing request — rejected."""
+    if window is not None and window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if not window:
+        return rows
+
+    def _date(r: Dict[str, Any]) -> Optional[str]:
+        ts = r.get("ts")
+        if isinstance(ts, str) and len(ts) >= 10:
+            d = ts[:10]
+            if d[4:5] == "-" and d[7:8] == "-":
+                return d
+        return None
+
+    dates_by_platform: Dict[str, set] = {}
+    for r in rows:
+        d = _date(r)
+        if d:
+            dates_by_platform.setdefault(_platform_class(r), set()).add(d)
+    keep = {
+        (plat, d)
+        for plat, dates in dates_by_platform.items()
+        for d in sorted(dates)[-window:]
+    }
+    return [
+        r for r in rows if (_platform_class(r), _date(r)) in keep
+    ]
+
+
+def tune_notes() -> List[Dict[str, Any]]:
+    """Tuning-cache entries whose winning config differs from the static
+    defaults — the gate's awareness that an autotune CHANGED the baseline
+    config: a throughput shift that coincides with a knob flip is a route
+    change, not a silent regression, and these notes make that visible in
+    the verdict (report field ``tuned_configs``; informational, never a
+    comparison input). Fails soft to an empty list."""
+    import dataclasses
+
+    from heat3d_tpu.core.config import SolverConfig
+
+    # the static defaults ARE SolverConfig's field defaults — derive them
+    # so a future default flip cannot desynchronize this report
+    static = {
+        f.name: f.default
+        for f in dataclasses.fields(SolverConfig)
+        if f.name in ("halo", "overlap", "time_blocking", "halo_order")
+    }
+    notes: List[Dict[str, Any]] = []
+    try:
+        from heat3d_tpu.tune.cache import cache_path, load
+
+        doc = load()
+        for key, e in sorted((doc.get("entries") or {}).items()):
+            if not isinstance(e, dict):
+                continue
+            cfgd = e.get("config") or {}
+            flips = {
+                k: cfgd.get(k)
+                for k, dflt in static.items()
+                if k in cfgd and cfgd.get(k) != dflt
+            }
+            if flips:
+                notes.append(
+                    {
+                        "key": key,
+                        "tuned": flips,
+                        "config": cfgd,
+                        "cache": cache_path(),
+                    }
+                )
+    except Exception:  # noqa: BLE001 - awareness is informational
+        return []
+    return notes
+
+
 def default_history_paths(current: Optional[str] = None) -> List[str]:
     """Default history: bench_results*.jsonl + BENCH_*.json next to the
     current results file AND in the working directory (a scratch-path
@@ -310,6 +409,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument("--warn-pct", type=float, default=DEFAULT_WARN_PCT)
     ap.add_argument("--fail-pct", type=float, default=DEFAULT_FAIL_PCT)
+    def _window(s: str) -> int:
+        n = int(s)
+        if n < 0:
+            raise argparse.ArgumentTypeError("--window must be >= 0")
+        return n
+
+    ap.add_argument(
+        "--window", type=_window, default=None, metavar="N",
+        help="baseline against the last N measurement sessions only "
+        "(sessions = distinct UTC measurement dates from row ts "
+        "provenance; rows without ts are excluded when windowing; 0 = "
+        "all). Default: all of history",
+    )
     ap.add_argument("--json", action="store_true",
                     help="print the machine-readable report (one JSON "
                     "object) instead of the table")
@@ -335,9 +447,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     history += load_history(
         [p for p in hist_paths if os.path.abspath(p) != cur_abs]
     )
+    history = filter_window(history, args.window)
     report = compare(
         current, history, warn_pct=args.warn_pct, fail_pct=args.fail_pct
     )
+    if args.window:
+        report["window_sessions"] = args.window
+    # autotune awareness: list cache entries that flipped a default knob,
+    # so a route change reads as a route change, not a silent regression
+    report["tuned_configs"] = tune_notes()
 
     if args.json:
         print(json.dumps(report))
@@ -361,6 +479,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  new  {n['row']} [{n['platform']}]: no baseline")
         for s in report["skipped"]:
             print(f"  skip {s['row']}: {s['reason']}")
+        for t in report["tuned_configs"]:
+            flips = " ".join(f"{k}={v}" for k, v in t["tuned"].items())
+            print(f"  note tune cache overrides defaults for {t['key']}: "
+                  f"{flips}")
         print(f"verdict: {report['verdict']}")
     return 1 if report["verdict"] == "fail" else 0
 

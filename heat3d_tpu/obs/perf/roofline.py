@@ -60,9 +60,13 @@ _FALLBACK_SPEC: Dict[str, Optional[float]] = {
 
 
 def peak_spec(platform: str) -> Dict[str, Optional[float]]:
-    """Peak spec for ``platform`` with env overrides applied
-    (``HEAT3D_PEAK_MEM_GBPS`` / ``HEAT3D_PEAK_GFLOPS``)."""
+    """Peak spec for ``platform``; precedence per field: env override
+    (``HEAT3D_PEAK_MEM_GBPS`` / ``HEAT3D_PEAK_GFLOPS``) > CALIBRATED
+    per-chip-generation value (``heat3d obs roofline --calibrate`` writes
+    it into the shared tuning-cache store, vector peak only — measured on
+    THIS chip beats any table) > the static conservative defaults."""
     spec = dict(PEAK_SPECS.get(platform, _FALLBACK_SPEC))
+    env_overridden = set()
     for env, key in (
         ("HEAT3D_PEAK_MEM_GBPS", "mem_gbps"),
         ("HEAT3D_PEAK_GFLOPS", "vector_gflops"),
@@ -71,8 +75,24 @@ def peak_spec(platform: str) -> Dict[str, Optional[float]]:
         if v:
             try:
                 spec[key] = float(v)
+                env_overridden.add(key)
             except ValueError:
                 pass  # a bad override must not kill a report
+    if "vector_gflops" not in env_overridden:
+        # calibrated lookup only when the CURRENT process runs the
+        # platform being asked about — a CPU box summarizing TPU rows
+        # must not apply its own calibrated CPU peak to them
+        try:
+            import jax
+
+            if jax.default_backend() == platform:
+                from heat3d_tpu.tune.cache import chip_generation, load_peak
+
+                calibrated = load_peak(chip_generation())
+                if calibrated:
+                    spec["vector_gflops"] = calibrated
+        except Exception:  # noqa: BLE001 - telemetry fails soft
+            pass
     return spec
 
 
@@ -295,6 +315,80 @@ def print_live_table(
             f"{fm:>8} {bm:>8} {bound:>6}{alias}",
             file=out,
         )
+
+
+# ---- peak calibration -------------------------------------------------------
+
+
+def calibrate_vpu_peak(
+    grid: int = 48,
+    iters: int = 3,
+    backend: str = "auto",
+    cache_path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Derive a calibrated VPU vector peak from a measured COMPUTE-BOUND
+    phase — the 27pt tb=1 stencil program (the densest tap chain; at
+    these arithmetic intensities its achieved GFLOP/s is a floor on the
+    sustainable vector rate, which is exactly what the roofline's
+    "fraction of peak" should divide by; see --vpu-gops's no-default
+    posture) — and store it per chip generation in the shared tuning
+    cache (``tune.cache.store_peak``). Returns the record; raises when
+    the stencil phase produced no usable flops/seconds (callers print
+    the error; calibration is an explicit operator action, not
+    fail-soft telemetry)."""
+    from heat3d_tpu.core.config import (
+        GridConfig,
+        MeshConfig,
+        Precision,
+        RunConfig,
+        SolverConfig,
+        StencilConfig,
+    )
+    from heat3d_tpu.tune.cache import chip_generation, store_peak
+
+    cfg = SolverConfig(
+        grid=GridConfig.cube(grid),
+        stencil=StencilConfig(kind="27pt"),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        precision=Precision.fp32(),
+        run=RunConfig(num_steps=1),
+        backend=backend,
+        time_blocking=1,
+    )
+    records = phase_costs_and_times(cfg, iters=iters)
+    stencil = next(
+        (r for r in records if r.get("phase") == "stencil"), None
+    )
+    if not stencil or stencil.get("error"):
+        raise RuntimeError(
+            "calibration needs the stencil phase program: "
+            f"{(stencil or {}).get('error', 'phase missing')}"
+        )
+    gflops = stencil.get("gflops")
+    if not isinstance(gflops, (int, float)) or gflops <= 0:
+        raise RuntimeError(
+            "stencil phase reported no flops (XLA treats custom calls as "
+            "opaque — calibrate with --backend jnp on that platform)"
+        )
+    chip = chip_generation()
+    path = store_peak(
+        chip,
+        float(gflops),
+        path=cache_path,
+        source=f"27pt tb=1 {grid}^3 stencil phase, backend={backend}",
+    )
+    from heat3d_tpu import obs
+
+    obs.get().event(
+        "peak_calibrated", chip=chip, vector_gflops=float(gflops),
+        grid=grid, backend=backend, path=path,
+    )
+    return {
+        "chip": chip,
+        "vector_gflops": float(gflops),
+        "seconds": stencil.get("seconds"),
+        "path": path,
+    }
 
 
 # ---- row model (promoted from scripts/roofline_check.py) -------------------
@@ -557,10 +651,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--time-blocking", type=int, default=1)
     ap.add_argument("--iters", type=int, default=3,
                     help="(live mode) timing iterations per phase")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="measure a compute-bound 27pt tb=1 stencil phase "
+                    "and cache its achieved GFLOP/s as this chip "
+                    "generation's VPU peak (shared tuning-cache store; "
+                    "later reports divide by it — ROADMAP 'calibrated "
+                    "peak specs')")
+    ap.add_argument("--cache", default=None,
+                    help="(with --calibrate) tuning-cache store path "
+                    "(default $HEAT3D_TUNE_CACHE)")
     ap.add_argument("--json", action="store_true",
                     help="(live mode) machine-readable records instead of "
                     "the table")
     args = ap.parse_args(argv)
+
+    if args.calibrate:
+        try:
+            rec = calibrate_vpu_peak(
+                grid=args.grid,
+                iters=args.iters,
+                backend=args.backend,
+                cache_path=args.cache,
+            )
+        except Exception as e:  # noqa: BLE001 - report, don't traceback
+            print(f"roofline --calibrate: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rec))
+        else:
+            print(
+                f"calibrated {rec['chip']}: vector peak "
+                f"{rec['vector_gflops']:.2f} GFLOP/s "
+                f"(stored in {rec['path']})"
+            )
+        return 0
 
     if args.results:
         rows = load_rows(args.results)

@@ -127,6 +127,62 @@ def exchange_halo(
     return u
 
 
+def exchange_halo_pairwise(
+    u: jax.Array,
+    mesh_cfg: MeshConfig,
+    bc: BoundaryCondition,
+    bc_value: float = 0.0,
+    width: int = 1,
+) -> jax.Array:
+    """Neighbor-pairwise ghost exchange: all six face ppermutes issued
+    concurrently from the RAW boundary faces, with no cross-axis data
+    dependence — the stagger-tolerant ordering (a host arriving one
+    exchange latency late delays only its own pairs, not a 3-deep axis
+    chain; ROADMAP "skew-aware halo tuning"). The price: corner and edge
+    ghost regions carry ``bc_value`` instead of diagonal-neighbor data,
+    so this ordering is only valid for face-only stencils (7pt) at
+    ``time_blocking <= 1`` — ``SolverConfig.__post_init__`` enforces it.
+    For those configs the padded result is value-identical to
+    :func:`exchange_halo` on every cell the stencil reads (a step's
+    output may still differ in final-ulp rounding: the differently
+    shaped pad/concat graph can change XLA's fusion/FMA contraction).
+    Must run inside shard_map over the mesh in ``mesh_cfg``."""
+    periodic = bc is BoundaryCondition.PERIODIC
+    with named_phase("halo_exchange"):
+        ghosts = []
+        for axis, (name, size) in enumerate(
+            zip(mesh_cfg.axis_names, mesh_cfg.shape)
+        ):
+            n = u.shape[axis]
+            if n < width:
+                raise ValueError(
+                    f"halo width {width} exceeds local extent {n} on "
+                    f"axis {axis}"
+                )
+            lo = lax.slice_in_dim(u, 0, width, axis=axis)
+            hi = lax.slice_in_dim(u, n - width, n, axis=axis)
+            # every axis_ghosts call reads only the RAW faces of u: the
+            # six permutes have no data dependence on each other, so
+            # XLA is free to run them all concurrently
+            ghosts.append(
+                axis_ghosts(lo, hi, name, size, periodic, bc_value)
+            )
+        out = u
+        for axis, (glo, ghi) in enumerate(ghosts):
+            # earlier axes already grew `out` by 2*width; the raw-face
+            # ghosts are padded with bc_value over those extents (the
+            # corner/edge zones a face-only stencil never reads)
+            pads = [
+                (width, width) if prev < axis else (0, 0)
+                for prev in range(3)
+            ]
+            if any(p != (0, 0) for p in pads):
+                glo = jnp.pad(glo, pads, constant_values=bc_value)
+                ghi = jnp.pad(ghi, pads, constant_values=bc_value)
+            out = lax.concatenate([glo, out, ghi], dimension=axis)
+        return out
+
+
 def exchange_halo_faces(
     u: jax.Array,
     mesh_cfg: MeshConfig,

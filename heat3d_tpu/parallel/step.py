@@ -93,6 +93,17 @@ def exchange(
                 u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value,
                 width=width,
             )
+        if cfg.halo_order == "pairwise":
+            # skew-tolerant ordering: six concurrent face ppermutes, no
+            # axis chain (config validation restricts it to face-only
+            # stencils at tb<=1, where every ghost the stencil reads is
+            # value-identical to the axis-ordered exchange)
+            from heat3d_tpu.parallel.halo import exchange_halo_pairwise
+
+            return exchange_halo_pairwise(
+                u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value,
+                width,
+            )
         return exchange_halo(
             u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value, width
         )
@@ -190,6 +201,11 @@ def _kernel_env_gate(cfg: SolverConfig):
     if cfg.backend not in ("pallas", "auto"):
         return False, False
     if cfg.is_padded:
+        return False, False
+    if cfg.halo_order != "axis":
+        # the direct/fused kernel families synthesize or patch ghosts
+        # assuming axis-ordered corner propagation; the pairwise ordering
+        # A/B is an EXCHANGE-path knob, so it pins the exchange path
         return False, False
     interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
     forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))

@@ -107,15 +107,19 @@ def row_key(cfg, bench: str = "throughput") -> str:
     matter which config in the sweep happened to land it first."""
     g = "x".join(str(v) for v in cfg.grid.shape)
     m = "x".join(str(v) for v in cfg.mesh.shape)
+    # the halo-ordering knob changes what a row measures, so it is part
+    # of the identity — suffixed ONLY when non-default, so every journal
+    # written before the knob existed keeps resuming cleanly
+    ho = "" if cfg.halo_order == "axis" else f":ho{cfg.halo_order}"
     if bench == "halo":
-        return f"halo:g{g}:m{m}:{cfg.precision.storage}:h{cfg.halo}"
+        return f"halo:g{g}:m{m}:{cfg.precision.storage}:h{cfg.halo}{ho}"
     env_bits = ",".join(
         f"{k}={os.environ[k]}" for k in ROUTE_ENV_KNOBS if k in os.environ
     )
     return (
         f"{bench}:g{g}:m{m}:{cfg.stencil.kind}:{cfg.precision.storage}"
         f":c{cfg.precision.compute}:b{cfg.backend}:tb{cfg.time_blocking}"
-        f":ov{int(cfg.overlap)}:h{cfg.halo}"
+        f":ov{int(cfg.overlap)}:h{cfg.halo}{ho}"
         + (f":env[{env_bits}]" if env_bits else "")
     )
 

@@ -1,0 +1,37 @@
+"""Autotuning: searched, cached, ledger-audited config selection.
+
+The judged-config surface (``SolverConfig``: backend route, halo
+transport, overlap, time blocking, halo-exchange ordering, mesh
+factorization) used to be tuned by hand — measurement scripts logged
+counterfactual pairs and ``scripts/ab_decide.py`` turned them into
+flip/keep recommendations a human applied to env-knob defaults. This
+package closes the loop (docs/TUNING.md):
+
+- :mod:`~heat3d_tpu.tune.space` — the declarative knob lattice over
+  ``SolverConfig`` with validity pruning (invalid combos never burn
+  measurement time).
+- :mod:`~heat3d_tpu.tune.measure` — the budgeted search driver: each
+  candidate runs through ``bench.harness`` with the full provenance
+  stack (sync-RTT stamping, ``rtt_dominated`` exclusion, ``tune_trial``
+  ledger events), with early-stopping on clearly-dominated candidates.
+- :mod:`~heat3d_tpu.tune.decide` — the pairwise single-knob decision
+  logic (promoted from ``scripts/ab_decide.py``, which is now a thin
+  wrapper).
+- :mod:`~heat3d_tpu.tune.cache` — the JSON tuning cache keyed by
+  (chip generation, process/device topology, grid-shape bucket, stencil,
+  dtype); ``backend='auto'`` / ``halo='auto'`` / ``time_blocking=0``
+  resolve through it with a safe static fallback, and every
+  hit/miss/stale lands in the run ledger. The same store holds the
+  calibrated per-chip peak specs ``obs roofline --calibrate`` derives.
+- :mod:`~heat3d_tpu.tune.cli` — ``heat3d tune run|show|apply|clear|lint``.
+"""
+
+from heat3d_tpu.tune.cache import (  # noqa: F401
+    ENV_CACHE,
+    cache_key,
+    cache_path,
+    chip_generation,
+    load_peak,
+    resolve_config,
+    store_peak,
+)

@@ -1,174 +1,33 @@
 #!/usr/bin/env python
-"""Turn a measurement session's A/B log lines into default-flip decisions.
-
-The measurement stages (scripts/tpu_measure_all.sh 3b-3f) log each
-counterfactual side as ``<knob tokens>: {throughput-row json}`` — e.g.
-``factor_y=0 tb=2: {...}`` or ``mehrstellen=1 tb=1: {...}`` or
-``direct: {...}``. This tool parses those lines, pairs rows that differ
-in exactly one knob (all other knobs equal), and prints the speedup per
-pair plus a recommendation — so the healthy-tunnel reaction (flip or
-keep each env-knob default, update BASELINE.md) is mechanical instead of
-eyeballed across a 1000-line log.
-
-Usage::
+"""Thin wrapper: the pairing/decision logic now lives in
+``heat3d_tpu/tune/decide.py``, promoted there so the autotuner's search
+driver (``heat3d tune run``) and this measurement-log workflow share one
+implementation (the same promotion pattern as scripts/roofline_check.py).
+This script keeps the historical invocation working:
 
     python scripts/ab_decide.py tpu_measure.log [more.log ...]
         [--all-sessions] [--min-win PCT]
 
-By default only lines after the LAST session header in each file are
-considered — any of the ``SESSION_HEADERS`` prefixes
-(``=== tpu_measure_all``, ``=== pod_ab_fused``) starts a session (a log
-accumulates many sessions; stale A/Bs from an older kernel would corrupt
-the decision).
-``--min-win`` (default 5.0) is the speedup percentage below which the
-recommendation is "keep default" (measurement noise / not worth a flip).
+Same flags, same output (see the module docstring there for session
+scoping and the --min-win threshold semantics).
 """
 
 from __future__ import annotations
 
-import argparse
-import itertools
-import json
-import re
+import os
 import sys
 
-# any of these starts a measurement session; scoping keeps only lines
-# after the LAST header present in the file (stale-session protection)
-SESSION_HEADERS = ("=== tpu_measure_all", "=== pod_ab_fused")
-_LINE = re.compile(r"^([A-Za-z0-9_=/. -]+?):\s*(\{.*\})\s*$")
-# bench-harness rows vs CLI summary lines (stage 3g logs the latter) name
-# the throughput metric differently; first present key wins
-METRIC_KEYS = ("gcell_per_sec_per_chip", "gcell_updates_per_sec_per_chip")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def _metric(row: dict):
-    for k in METRIC_KEYS:
-        if k in row:
-            return float(row[k])
-    return None
-
-
-def parse_knobs(prefix: str) -> dict:
-    """``factor_y=0 tb=2`` -> {'factor_y': '0', 'tb': '2'};
-    bare words become ``mode`` (``direct`` -> {'mode': 'direct'})."""
-    knobs = {}
-    for tok in prefix.split():
-        if "=" in tok:
-            k, v = tok.split("=", 1)
-            knobs[k] = v
-        else:
-            knobs["mode"] = tok
-    return knobs
-
-
-def parse_lines(text: str, all_sessions: bool = False):
-    """Yield (knobs, row) for every A/B line in the chosen session scope."""
-    if not all_sessions:
-        cut = max(
-            (text.rindex(h) for h in SESSION_HEADERS if h in text),
-            default=None,
-        )
-        if cut is not None:
-            text = text[cut:]
-    for line in text.splitlines():
-        m = _LINE.match(line.strip())
-        if not m:
-            continue
-        try:
-            row = json.loads(m.group(2))
-        except json.JSONDecodeError:
-            continue
-        if not (isinstance(row, dict) and _metric(row) is not None):
-            continue
-        yield parse_knobs(m.group(1)), row
-
-
-def pair_rows(entries):
-    """Yield (knob, fixed, a, b) for entry pairs differing in exactly one
-    knob value; ``fixed`` is the shared remaining-knob context."""
-    for (ka, ra), (kb, rb) in itertools.combinations(entries, 2):
-        if set(ka) != set(kb):
-            continue
-        diff = [k for k in ka if ka[k] != kb[k]]
-        if len(diff) != 1:
-            continue
-        k = diff[0]
-        fixed = {n: v for n, v in ka.items() if n != k}
-        # deterministic orientation: lower knob value first
-        if str(ka[k]) <= str(kb[k]):
-            yield k, fixed, (ka[k], ra), (kb[k], rb)
-        else:
-            yield k, fixed, (kb[k], rb), (ka[k], ra)
-
-
-def decide(entries, min_win_pct: float = 5.0):
-    """Return decision dicts for every single-knob A/B pair found."""
-    out = []
-    for knob, fixed, (va, ra), (vb, rb) in pair_rows(entries):
-        ga, gb = _metric(ra), _metric(rb)
-        if ga <= 0 or gb <= 0:
-            continue
-        winner = vb if gb >= ga else va
-        # winner relative to LOSER, symmetric in orientation: the same gap
-        # must yield the same margin whichever side the lower knob value is
-        margin = (max(ga, gb) / min(ga, gb) - 1.0) * 100.0
-        out.append(
-            {
-                "knob": knob,
-                "context": fixed,
-                "values": {va: round(ga, 2), vb: round(gb, 2)},
-                "winner": winner,
-                "speedup_pct": round(margin, 1),
-                "decisive": margin >= min_win_pct,
-                "recommend": (
-                    f"{knob}={winner} wins {margin:.1f}%"
-                    + ("" if margin >= min_win_pct else
-                       " — below threshold, keep default")
-                ),
-            }
-        )
-    return out
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("logs", nargs="+", help="measurement logs to scan")
-    ap.add_argument("--all-sessions", action="store_true",
-                    help="consider every session in each log, not just the last")
-    ap.add_argument("--min-win", type=float, default=5.0,
-                    help="speedup %% below which the call is 'keep default'")
-    args = ap.parse_args(argv)
-    # Pairing happens PER FILE: rows from different logs come from
-    # different sessions/machines/kernel versions, and pairing across them
-    # would silently defeat the stale-session protection.
-    decisions = []
-    found_any = False
-    for path in args.logs:
-        try:
-            with open(path) as f:
-                entries = list(parse_lines(f.read(), args.all_sessions))
-        except OSError as e:
-            print(f"ab_decide: cannot read {path}: {e}", file=sys.stderr)
-            return 2
-        found_any = found_any or bool(entries)
-        decisions.extend(decide(entries, args.min_win))
-    if not found_any:
-        print("ab_decide: no A/B lines found in the chosen session scope",
-              file=sys.stderr)
-        return 1
-    if not decisions:
-        print("ab_decide: A/B lines found but no single-knob pairs",
-              file=sys.stderr)
-        return 1
-    for d in sorted(decisions,
-                    key=lambda d: (-d["decisive"], -d["speedup_pct"])):
-        ctx = " ".join(f"{k}={v}" for k, v in sorted(d["context"].items()))
-        vals = ", ".join(f"{v}: {g}" for v, g in d["values"].items())
-        flag = "FLIP?" if d["decisive"] else "keep "
-        print(f"[{flag}] {d['knob']:<12} ({ctx or 'no context'})  "
-              f"{vals}  ->  {d['recommend']}")
-    return 0
-
+from heat3d_tpu.tune.decide import (  # noqa: E402,F401 - re-exported API
+    METRIC_KEYS,
+    SESSION_HEADERS,
+    decide,
+    main,
+    pair_rows,
+    parse_knobs,
+    parse_lines,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,675 @@
+"""Autotuning tests (tier-1, CPU): the promoted pairing/decision logic
+(single-knob pairing, --min-win threshold, session scoping), the search
+space's validity pruning, the pairwise halo ordering's equivalence on
+the cells a face-only stencil reads, the tuning cache (store/lint,
+hit/miss/stale resolution, static fallback), peak calibration feeding
+peak_spec, the regression gate's --window session hygiene, and the e2e
+acceptance loop: a CPU `tune run` over a 2-point space writes a cache
+entry that a subsequent auto-knob solver run resolves (tune_cache_hit in
+the ledger) with byte-identical results vs the statically-configured
+run."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from heat3d_tpu import obs
+from heat3d_tpu.core.config import (
+    GridConfig,
+    MeshConfig,
+    Precision,
+    RunConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.tune import cache as tcache
+from heat3d_tpu.tune import decide as tdecide
+from heat3d_tpu.tune import space as tspace
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    """Every test gets its own tune cache and a detached ledger."""
+    monkeypatch.setenv(tcache.ENV_CACHE, str(tmp_path / "tune_cache.json"))
+    monkeypatch.delenv(tcache.ENV_DISABLE, raising=False)
+    monkeypatch.setenv("HEAT3D_COST_ANALYSIS", "0")
+    obs.deactivate()
+    yield
+    obs.deactivate()
+
+
+def _cfg(n=12, **kw):
+    return SolverConfig(grid=GridConfig.cube(n), **kw)
+
+
+def _row(gcell):
+    return {"gcell_per_sec_per_chip": gcell}
+
+
+# ---- tune.decide (promoted from scripts/ab_decide.py) ----------------------
+
+
+def test_decide_pairs_single_knob_only():
+    """Entries differing in two knobs must not pair; single-knob pairs
+    must, keyed on the differing knob with the rest as context."""
+    entries = [
+        ({"tb": "1", "ov": "0"}, _row(10.0)),
+        ({"tb": "2", "ov": "0"}, _row(12.0)),  # pairs with #1 on tb
+        ({"tb": "2", "ov": "1"}, _row(15.0)),  # pairs with #2 on ov
+        ({"halo": "dma"}, _row(9.0)),  # different knob set: never pairs
+    ]
+    ds = tdecide.decide(entries)
+    assert {(d["knob"], tuple(sorted(d["context"].items()))) for d in ds} == {
+        ("tb", (("ov", "0"),)),
+        ("ov", (("tb", "2"),)),
+    }
+    tb = next(d for d in ds if d["knob"] == "tb")
+    assert tb["winner"] == "2"
+    assert tb["speedup_pct"] == pytest.approx(20.0)
+
+
+def test_decide_min_win_threshold():
+    """A win below --min-win is recorded but not decisive ('keep
+    default'); at/above the threshold it flips."""
+    entries = [({"tb": "1"}, _row(100.0)), ({"tb": "2"}, _row(103.0))]
+    (d,) = tdecide.decide(entries, min_win_pct=5.0)
+    assert not d["decisive"] and "keep default" in d["recommend"]
+    (d,) = tdecide.decide(entries, min_win_pct=2.0)
+    assert d["decisive"]
+
+
+def test_decide_margin_orientation_symmetric():
+    """The same gap yields the same margin whichever side the lower knob
+    value lands on (winner is judged relative to the LOSER)."""
+    a = tdecide.decide([({"k": "0"}, _row(10.0)), ({"k": "1"}, _row(12.0))])
+    b = tdecide.decide([({"k": "1"}, _row(10.0)), ({"k": "0"}, _row(12.0))])
+    assert a[0]["speedup_pct"] == b[0]["speedup_pct"] == pytest.approx(20.0)
+
+
+def test_parse_lines_scopes_to_last_session():
+    text = "\n".join(
+        [
+            "=== tpu_measure_all old",
+            'tb=1: {"gcell_per_sec_per_chip": 1.0}',
+            "=== tpu_measure_all new",
+            'tb=2: {"gcell_per_sec_per_chip": 2.0}',
+        ]
+    )
+    got = list(tdecide.parse_lines(text))
+    assert [k for k, _ in got] == [{"tb": "2"}]
+    assert len(list(tdecide.parse_lines(text, all_sessions=True))) == 2
+
+
+def test_ab_decide_script_is_thin_wrapper():
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ab_decide_wrapper", os.path.join(repo, "scripts", "ab_decide.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main is tdecide.main
+
+
+# ---- tune.space ------------------------------------------------------------
+
+
+def test_space_prunes_invalid_and_unsupported():
+    base = _cfg(backend="jnp")
+    cands = tspace.enumerate_candidates(
+        base,
+        {
+            "halo": ("ppermute", "dma"),
+            "halo_order": ("axis", "pairwise"),
+            "time_blocking": (1, 2),
+        },
+    )
+    by = {tuple(sorted(c.knobs.items())): c for c in cands}
+
+    def get(halo, order, tb):
+        return by[
+            tuple(
+                sorted(
+                    {
+                        "halo": halo, "halo_order": order,
+                        "time_blocking": str(tb),
+                    }.items()
+                )
+            )
+        ]
+
+    # the static default rides first and is measurable
+    assert cands[0].prune is None and cands[0].knobs["halo"] == "ppermute"
+    # dma needs TPU: pruned on CPU with the production error message
+    assert "dma" in (get("dma", "axis", 1).prune or "")
+    # pairwise + tb=2 is structurally invalid (config validation)
+    assert get("ppermute", "pairwise", 2).prune.startswith("invalid:")
+    # pairwise + tb=1 on 7pt is measurable
+    assert get("ppermute", "pairwise", 1).prune is None
+
+
+def test_space_prunes_pairwise_for_27pt():
+    base = _cfg(backend="jnp", stencil=StencilConfig(kind="27pt"))
+    cands = tspace.enumerate_candidates(
+        base, {"halo_order": ("axis", "pairwise")}
+    )
+    pw = [c for c in cands if c.knobs["halo_order"] == "pairwise"]
+    assert pw and all("invalid" in c.prune for c in pw)
+
+
+def test_space_prunes_oversized_mesh():
+    base = _cfg(backend="jnp")
+    cands = tspace.enumerate_candidates(base, {"mesh": ((64, 1, 1),)})
+    over = [c for c in cands if c.knobs["mesh"] == "64x1x1"]
+    assert over and all(c.prune for c in over)
+
+
+def test_space_rejects_non_concrete_knob_values():
+    """Auto sentinels cannot be searched: a trial labeled tb=0 would
+    silently measure the static resolution under a wrong label and cache
+    a dead entry."""
+    with pytest.raises(ValueError, match="concrete"):
+        tspace.parse_knob_values("time_blocking", "0,2")
+    with pytest.raises(ValueError, match="concrete"):
+        tspace.parse_knob_values("halo", "auto,dma")
+    with pytest.raises(ValueError, match="not concrete"):
+        tspace.enumerate_candidates(
+            _cfg(backend="jnp"), {"time_blocking": (0, 2)}, validate=False
+        )
+
+
+def test_mesh_candidates_shapes():
+    ms = tspace.mesh_candidates(8)
+    assert (8, 1, 1) in ms and (2, 2, 2) in ms
+    assert all(a * b * c == 8 for a, b, c in ms)
+
+
+# ---- the pairwise halo ordering --------------------------------------------
+
+
+def test_pairwise_exchange_matches_axis_on_stencil_cells():
+    """On the ppermute transport the pairwise exchange's padded result is
+    value-identical to the axis-ordered one everywhere a 7pt stencil
+    reads (on a (1,1,1) mesh: everywhere), and a multi-step solver run
+    agrees to fp32 tolerance (graph-shape differences may move final-ulp
+    rounding)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+    from heat3d_tpu.parallel.halo import exchange_halo, exchange_halo_pairwise
+    from heat3d_tpu.parallel.topology import build_mesh
+    from heat3d_tpu.utils.compat import shard_map
+
+    base = _cfg(backend="jnp")
+    mesh = build_mesh(base.mesh)
+    spec = P(*base.mesh.axis_names)
+
+    def sharded(fn):
+        return jax.jit(
+            shard_map(
+                fn, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+            )
+        )
+
+    u0 = np.random.default_rng(0).standard_normal((12, 12, 12)).astype(
+        np.float32
+    )
+    pa = sharded(
+        lambda u: exchange_halo(u, base.mesh, base.stencil.bc, 0.0, 1)
+    )(jnp.asarray(u0))
+    pb = sharded(
+        lambda u: exchange_halo_pairwise(u, base.mesh, base.stencil.bc, 0.0, 1)
+    )(jnp.asarray(u0))
+    assert np.array_equal(np.asarray(pa), np.asarray(pb))
+
+    sa = HeatSolver3D(base)
+    sb = HeatSolver3D(dataclasses.replace(base, halo_order="pairwise"))
+    ua = sa.gather(sa.run(sa.init_state(u0), 5))
+    ub = sb.gather(sb.run(sb.init_state(u0), 5))
+    np.testing.assert_allclose(ua, ub, rtol=1e-6, atol=1e-6)
+
+
+def test_pairwise_pins_exchange_path():
+    """The ordering knob is an exchange-path A/B: the direct/fused kernel
+    dispatch must stand down under pairwise."""
+    from heat3d_tpu.parallel.step import _direct_kernel_fn, _kernel_env_gate
+
+    cfg = dataclasses.replace(_cfg(backend="auto"), halo_order="pairwise")
+    assert _kernel_env_gate(cfg) == (False, False)
+    assert _direct_kernel_fn(cfg, halo=1, multichip=True) is None
+
+
+# ---- tune.cache ------------------------------------------------------------
+
+
+def _seed_entry(cfg=None, key=None, jax_version=None, **config_over):
+    """Write one cache entry for ``cfg``'s key as a prior `tune run`
+    would, optionally forging provenance/config fields."""
+    cfg = cfg or _cfg()
+    winner = dataclasses.replace(
+        cfg, backend="jnp", halo="ppermute", time_blocking=2,
+        **config_over,
+    )
+    key = key or tcache.cache_key(cfg)
+    path = tcache.store_entry(key, winner, 2.0, default_metric=1.0)
+    if jax_version is not None:
+        doc = json.load(open(path))
+        doc["entries"][key]["provenance"]["jax_version"] = jax_version
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return key, path
+
+
+def test_cache_store_show_lint_roundtrip():
+    key, path = _seed_entry()
+    assert tcache.lint() == []
+    doc = tcache.load()
+    e = doc["entries"][key]
+    assert e["config"]["time_blocking"] == 2
+    assert e["gcell_per_sec_per_chip"] == 2.0
+    assert e["provenance"]["jax_version"]
+    # lint catches a broken entry
+    doc["entries"][key]["config"].pop("halo")
+    del doc["entries"][key]["gcell_per_sec_per_chip"]
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    defects = tcache.lint()
+    assert any("halo" in d for d in defects)
+    assert any("gcell_per_sec_per_chip" in d for d in defects)
+
+
+def test_resolve_hit_applies_only_auto_knobs(tmp_path):
+    _seed_entry()
+    ledger = str(tmp_path / "led.jsonl")
+    obs.activate(ledger)
+    # all-auto: every knob comes from the entry
+    r = tcache.resolve_config(_cfg(backend="auto", halo="auto", time_blocking=0))
+    assert (r.backend, r.halo, r.time_blocking) == ("jnp", "ppermute", 2)
+    # explicit tb pins: only backend/halo resolve
+    r2 = tcache.resolve_config(
+        _cfg(backend="auto", halo="auto", time_blocking=1)
+    )
+    assert r2.time_blocking == 1 and r2.backend == "jnp"
+    obs.deactivate()
+    evs = [json.loads(ln) for ln in open(ledger)]
+    hits = [e for e in evs if e["event"] == "tune_cache_hit"]
+    assert len(hits) == 2
+    assert hits[0]["applied"] == {
+        "backend": "jnp", "halo": "ppermute", "time_blocking": 2
+    }
+
+
+def test_resolve_miss_and_absent_cache_fall_back_static(tmp_path):
+    ledger = str(tmp_path / "led.jsonl")
+    obs.activate(ledger)
+    r = tcache.resolve_config(_cfg(halo="auto", time_blocking=0))
+    assert (r.halo, r.time_blocking) == ("ppermute", 1)
+    assert r.backend == "auto"  # backend keeps its static 'auto' semantics
+    obs.deactivate()
+    evs = [json.loads(ln) for ln in open(ledger)]
+    (miss,) = [e for e in evs if e["event"] == "tune_cache_miss"]
+    assert miss["cache_present"] is False
+
+
+def test_resolve_stale_on_jax_version_mismatch(tmp_path):
+    _seed_entry(jax_version="0.0.0-not-this-one")
+    ledger = str(tmp_path / "led.jsonl")
+    obs.activate(ledger)
+    r = tcache.resolve_config(_cfg(halo="auto", time_blocking=0))
+    assert (r.halo, r.time_blocking) == ("ppermute", 1)
+    obs.deactivate()
+    evs = [json.loads(ln) for ln in open(ledger)]
+    (stale,) = [e for e in evs if e["event"] == "tune_cache_stale"]
+    assert "jax_version" in stale["reason"]
+
+
+def test_resolve_stale_on_dma_entry_off_tpu(tmp_path):
+    """A cached dma transport is unusable on CPU: stale + fallback, not a
+    crash and not a half-applied entry."""
+    cfg = _cfg()
+    key = tcache.cache_key(cfg)
+    winner = dataclasses.replace(cfg, backend="jnp", time_blocking=1)
+    path = tcache.store_entry(key, winner, 2.0)
+    doc = json.load(open(path))
+    doc["entries"][key]["config"]["halo"] = "dma"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    ledger = str(tmp_path / "led.jsonl")
+    obs.activate(ledger)
+    r = tcache.resolve_config(_cfg(halo="auto"))
+    assert r.halo == "ppermute"
+    obs.deactivate()
+    evs = [json.loads(ln) for ln in open(ledger)]
+    (stale,) = [e for e in evs if e["event"] == "tune_cache_stale"]
+    assert "dma" in stale["reason"]
+
+
+def test_resolve_stale_on_cached_knobs_that_do_not_build(tmp_path):
+    """A cached config that cannot BUILD in this environment (e.g.
+    backend='pallas' off-TPU) degrades to the static fallback with a
+    stale event — it must never kill the run at solver construction."""
+    cfg = _cfg()
+    key = tcache.cache_key(cfg)
+    path = tcache.store_entry(
+        key, dataclasses.replace(cfg, backend="jnp"), 2.0
+    )
+    doc = json.load(open(path))
+    doc["entries"][key]["config"]["backend"] = "pallas"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    ledger = str(tmp_path / "led.jsonl")
+    obs.activate(ledger)
+    r = tcache.resolve_config(_cfg(backend="auto"))
+    assert r.backend == "auto"  # static fallback, not a crash
+    obs.deactivate()
+    evs = [json.loads(ln) for ln in open(ledger)]
+    (stale,) = [e for e in evs if e["event"] == "tune_cache_stale"]
+    assert "do not build" in stale["reason"]
+
+
+def test_resolve_miss_events_dedupe_per_run(tmp_path):
+    """Resolution runs at the entry point AND the solver constructor;
+    the same miss must ledger once per run, not once per resolution."""
+    ledger = str(tmp_path / "led.jsonl")
+    obs.activate(ledger)
+    tcache.resolve_config(_cfg(backend="auto"))
+    tcache.resolve_config(_cfg(backend="auto"))
+    obs.deactivate()
+    evs = [json.loads(ln) for ln in open(ledger)]
+    assert len([e for e in evs if e["event"] == "tune_cache_miss"]) == 1
+
+
+def test_resolve_disabled_by_env(monkeypatch):
+    _seed_entry()
+    monkeypatch.setenv(tcache.ENV_DISABLE, "1")
+    r = tcache.resolve_config(_cfg(backend="auto", time_blocking=0))
+    assert r.backend == "auto" and r.time_blocking == 1
+
+
+def test_solver_resolves_auto_knobs_through_cache():
+    """HeatSolver3D is the library-level safety net: an auto-knob config
+    builds the cached route."""
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    _seed_entry()
+    s = HeatSolver3D(_cfg(backend="auto", halo="auto", time_blocking=0))
+    assert s.cfg.time_blocking == 2 and s.cfg.backend == "jnp"
+
+
+# ---- calibrated peaks ------------------------------------------------------
+
+
+def test_calibrate_writes_peak_and_peak_spec_prefers_it(monkeypatch):
+    import jax
+
+    from heat3d_tpu.obs.perf.roofline import calibrate_vpu_peak, peak_spec
+
+    monkeypatch.delenv("HEAT3D_PEAK_GFLOPS", raising=False)
+    rec = calibrate_vpu_peak(grid=16, iters=1, backend="jnp")
+    assert rec["chip"] == tcache.chip_generation()
+    assert rec["vector_gflops"] > 0
+    assert tcache.load_peak(rec["chip"]) == rec["vector_gflops"]
+    spec = peak_spec(jax.default_backend())
+    assert spec["vector_gflops"] == pytest.approx(rec["vector_gflops"])
+    # env override still wins over the calibrated value
+    monkeypatch.setenv("HEAT3D_PEAK_GFLOPS", "123.5")
+    assert peak_spec(jax.default_backend())["vector_gflops"] == 123.5
+
+
+def test_cache_lint_catches_bad_peak(tmp_path):
+    path = tcache.store_peak("somechip", 10.0)
+    doc = json.load(open(path))
+    doc["peaks"]["somechip"]["vector_gflops"] = -1
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    assert any("somechip" in d for d in tcache.lint())
+
+
+# ---- regression-gate history hygiene (--window) ----------------------------
+
+
+def test_regress_window_ignores_ancient_best_row():
+    from heat3d_tpu.obs.perf import regress
+
+    def row(gcell, ts):
+        return {
+            "bench": "throughput", "ts": ts, "platform": "cpu",
+            "grid": [32, 32, 32], "stencil": "7pt", "mesh": [1, 1, 1],
+            "dtype": "float32", "compute_dtype": "float32",
+            "backend": "auto", "time_blocking": 1, "overlap": False,
+            "halo": "ppermute", "gcell_per_sec_per_chip": gcell,
+        }
+
+    ancient_best = row(100.0, "2024-01-01T00:00:00Z")
+    recent = row(50.0, "2026-08-01T00:00:00Z")
+    current = [row(49.0, "2026-08-02T00:00:00Z")]
+    # full history: the ancient best makes this a >15% fail
+    full = regress.compare(current, [ancient_best, recent])
+    assert full["verdict"] == "fail"
+    # windowed to the last 1 session: the ancient row ages out
+    windowed = regress.compare(
+        current, regress.filter_window([ancient_best, recent], 1)
+    )
+    assert windowed["verdict"] == "pass"
+    # no-ts rows are excluded while windowing (age unprovable)
+    no_ts = {k: v for k, v in ancient_best.items() if k != "ts"}
+    assert regress.filter_window([no_ts, recent], 1) == [recent]
+    # window=None keeps everything; negative windows are caller bugs
+    assert regress.filter_window([ancient_best, recent], None) == [
+        ancient_best, recent
+    ]
+    with pytest.raises(ValueError):
+        regress.filter_window([recent], -2)
+    # sessions count PER PLATFORM: recent CPU debug sessions must not
+    # evict the TPU baseline pool
+    tpu_old = dict(recent, platform="tpu", ts="2026-06-01T00:00:00Z")
+    cpu_new = [
+        dict(recent, ts="2026-08-01T00:00:00Z"),
+        dict(recent, ts="2026-08-02T00:00:00Z"),
+    ]
+    kept = regress.filter_window([tpu_old] + cpu_new, 1)
+    assert tpu_old in kept and cpu_new[1] in kept and cpu_new[0] not in kept
+
+
+def test_regress_reports_tuned_configs():
+    from heat3d_tpu.obs.perf.regress import tune_notes
+
+    assert tune_notes() == []  # empty cache: no notes
+    _seed_entry()  # winner flips time_blocking to 2
+    notes = tune_notes()
+    assert len(notes) == 1 and notes[0]["tuned"] == {"time_blocking": 2}
+
+
+# ---- e2e acceptance: search -> cache -> resolve, byte-identical ------------
+
+
+def test_e2e_tune_run_writes_cache_solver_resolves_byte_identical(tmp_path):
+    """The PR acceptance loop on CPU: `tune run` over a 2-point space
+    completes within budget and writes a cache entry; `tune show`
+    displays it; a subsequent solver run with auto knobs resolves its
+    route from the cache (tune_cache_hit in the ledger) with
+    byte-identical results vs the statically-configured run."""
+    import io
+    from contextlib import redirect_stdout
+
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+    from heat3d_tpu.tune.cli import main as tune_main
+
+    ledger = str(tmp_path / "led.jsonl")
+    rc = tune_main(
+        [
+            "run", "--grid", "16", "--steps", "6", "--repeats", "1",
+            # probing off: both points must fully measure so the cached
+            # winner is deterministic for the byte-identity check below
+            "--probe-steps", "0", "--budget-s", "120",
+            "--knob", "time_blocking=1,2", "--ledger", ledger,
+        ]
+    )
+    assert rc == 0
+    evs = [json.loads(ln) for ln in open(ledger)]
+    trials = [e for e in evs if e["event"] == "tune_trial"]
+    assert sum(1 for t in trials if t.get("status") == "measured") >= 2
+    assert [e for e in evs if e["event"] == "tune_winner"]
+
+    key = tcache.cache_key(_cfg(16))
+    entry = tcache.load()["entries"][key]
+    assert entry["config"]["backend"] != "auto"  # concretized
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        assert tune_main(["show"]) == 0
+    assert key in out.getvalue()
+    assert "vs default" in out.getvalue()
+
+    # auto-knob run resolves through the cache...
+    ledger2 = str(tmp_path / "led2.jsonl")
+    obs.activate(ledger2)
+    auto_cfg = _cfg(16, backend="auto", halo="auto", time_blocking=0)
+    s_auto = HeatSolver3D(auto_cfg)
+    u0 = np.random.default_rng(3).standard_normal((16, 16, 16)).astype(
+        np.float32
+    )
+    got_auto = s_auto.gather(s_auto.run(s_auto.init_state(u0), 7))
+    obs.deactivate()
+    hits = [
+        json.loads(ln)
+        for ln in open(ledger2)
+        if json.loads(ln)["event"] == "tune_cache_hit"
+    ]
+    assert hits and hits[0]["key"] == key
+
+    # ...and the result is byte-identical to the statically-configured run
+    static_cfg = _cfg(
+        16,
+        backend=entry["config"]["backend"],
+        halo=entry["config"]["halo"],
+        overlap=entry["config"]["overlap"],
+        time_blocking=entry["config"]["time_blocking"],
+        halo_order=entry["config"]["halo_order"],
+    )
+    s_static = HeatSolver3D(static_cfg)
+    got_static = s_static.gather(s_static.run(s_static.init_state(u0), 7))
+    assert np.array_equal(got_auto, got_static)
+
+
+def test_search_early_stops_dominated_candidates(monkeypatch, tmp_path):
+    """A candidate whose probe is clearly dominated by the best so far
+    skips its full measurement; rtt_dominated trials never win."""
+    from heat3d_tpu.bench import harness
+    from heat3d_tpu.tune import measure as tmeasure
+
+    speeds = {1: 10.0, 2: 1.0}  # tb=2 is hopeless: must be pruned
+
+    def fake_bench(cfg, steps=50, warmup=2, repeats=3):
+        return {
+            "bench": "throughput",
+            "gcell_per_sec_per_chip": speeds[cfg.time_blocking],
+            "rtt_dominated": False,
+        }
+
+    monkeypatch.setattr(harness, "bench_throughput", fake_bench)
+    res = tmeasure.run_search(
+        _cfg(12, backend="jnp"),
+        space={"time_blocking": (1, 2)},
+        steps=4, repeats=1, probe_steps=2,
+        write_cache=False,
+    )
+    statuses = {t.knobs["time_blocking"]: t.status for t in res.trials}
+    assert statuses["1"] == "measured"
+    assert statuses["2"] == "dominated"
+    assert res.winner.knobs["time_blocking"] == "1"
+
+
+def test_rtt_dominated_default_never_wins_or_anchors_speedup(monkeypatch):
+    """An RTT-dominated default can neither win nor serve as the cached
+    speedup denominator; a clean candidate still gets cached."""
+    from heat3d_tpu.bench import harness
+    from heat3d_tpu.tune import measure as tmeasure
+
+    def fake_bench(cfg, steps=50, warmup=2, repeats=3):
+        dominated = cfg.time_blocking == 1  # the default trial
+        return {
+            "bench": "throughput",
+            "gcell_per_sec_per_chip": 9.0 if dominated else 3.0,
+            "rtt_dominated": dominated,
+        }
+
+    monkeypatch.setattr(harness, "bench_throughput", fake_bench)
+    res = tmeasure.run_search(
+        _cfg(12, backend="jnp"),
+        space={"time_blocking": (1, 2)},
+        steps=4, repeats=1, probe_steps=0,
+    )
+    assert res.winner.knobs["time_blocking"] == "2"
+    assert res.speedup_vs_default is None
+    entry = tcache.load()["entries"][res.key]
+    assert entry["default_gcell_per_sec_per_chip"] is None
+    assert entry["config"]["time_blocking"] == 2
+
+
+def test_search_pins_base_auto_sentinels_to_static_defaults(monkeypatch):
+    """A base with halo='auto'/time_blocking=0 is searched (and cached)
+    as the static defaults those sentinels mean — the written entry must
+    pass its own lint and resolve later, never carry a sentinel."""
+    from heat3d_tpu.bench import harness
+    from heat3d_tpu.tune import measure as tmeasure
+
+    monkeypatch.setattr(
+        harness,
+        "bench_throughput",
+        lambda cfg, steps=50, warmup=2, repeats=3: {
+            "bench": "throughput",
+            "gcell_per_sec_per_chip": 1.0,
+            "rtt_dominated": False,
+        },
+    )
+    res = tmeasure.run_search(
+        _cfg(12, backend="auto", halo="auto", time_blocking=0),
+        space={"overlap": (False,)},
+        steps=2, repeats=1, probe_steps=0,
+    )
+    entry = tcache.load()["entries"][res.key]
+    assert entry["config"]["halo"] == "ppermute"
+    assert entry["config"]["time_blocking"] == 1
+    assert entry["config"]["backend"] != "auto"
+    assert tcache.lint() == []
+
+
+def test_tune_run_budget_zero_still_measures_default(tmp_path):
+    """Budget 0: the static default is measured anyway (the reference
+    must exist), everything else is recorded as budget-stopped."""
+    from heat3d_tpu.tune import measure as tmeasure
+
+    ledger = str(tmp_path / "led.jsonl")
+    obs.activate(ledger)
+    res = tmeasure.run_search(
+        _cfg(12, backend="jnp"),
+        space={"time_blocking": (1, 2)},
+        budget_s=0.0,
+        steps=4,
+        repeats=1,
+        probe_steps=0,
+    )
+    obs.deactivate()
+    assert res.default is not None and res.default.status == "measured"
+    assert any(t.status == "budget" for t in res.trials)
+    evs = [json.loads(ln) for ln in open(ledger)]
+    assert [e for e in evs if e["event"] == "tune_budget_exhausted"]
+
+
+def test_tune_apply_emits_flag_line(capsys):
+    from heat3d_tpu.tune.cli import main as tune_main
+
+    _seed_entry()
+    assert tune_main(["apply", "--grid", "12"]) == 0
+    line = capsys.readouterr().out.strip()
+    assert "--backend jnp" in line
+    assert "--time-blocking 2" in line
+    # no entry for another context -> rc 1
+    assert tune_main(["apply", "--grid", "12", "--stencil", "27pt"]) == 1
