@@ -105,13 +105,15 @@ def bench_throughput(
     mehrstellen = _mehrstellen_route(cfg)
     direct = _resolved_direct(cfg)
     fused = _resolved_fused_dma(cfg)
+    streamk = _resolved_streamk(cfg, direct=direct)
     from heat3d_tpu.parallel.step import _kernel_env_gate
 
-    # the fused route has an off-TPU emulation tier (the pure-XLA
-    # reference contracts under HEAT3D_DIRECT_INTERPRET): record it
-    # EXPLICITLY so A/B tooling cannot mistake an emulated row for a real
-    # Mosaic-kernel row without cross-checking the platform field
+    # the fused routes have an off-TPU emulation tier (interpret mode /
+    # the pure-XLA reference contracts under HEAT3D_DIRECT_INTERPRET):
+    # record it EXPLICITLY so A/B tooling cannot mistake an emulated row
+    # for a real Mosaic-kernel row without cross-checking the platform
     fused_emulated = bool(fused and _kernel_env_gate(cfg)[1])
+    streamk_emulated = bool(streamk and _kernel_env_gate(cfg)[1])
     # cost-analysis provenance (obs/perf/roofline): XLA's own FLOPs/bytes
     # for ONE step of this config, so a row's achieved-vs-peak is
     # computable from the row alone (`obs summary` roofline section,
@@ -183,6 +185,18 @@ def bench_throughput(
         # ... and whether that resolution was the XLA reference EMULATION
         # tier rather than the Mosaic kernel (ADVICE r5 item 2)
         "fused_dma_emulated": fused_emulated,
+        # deep-tb route provenance: whether the fused k-sweep streaming
+        # kernel resolved (tb=3..4); without it a tb=3 row's traffic model
+        # can't distinguish one fused sweep from k plain sweeps. The
+        # _emulated twin marks interpret-tier resolutions (same contract
+        # as fused_dma_emulated).
+        "streamk_path": streamk,
+        "streamk_emulated": streamk_emulated,
+        # redundant-compute honesty (required by check_provenance.py on
+        # tb>1 rows): fraction of the superstep's executed stencil flops
+        # that are ghost-ring recompute — the discount between this row's
+        # measured Gcell/s and what the chip actually sustained
+        "cost_redundant_flops_frac": _redundant_frac(cfg),
         **cost_fields,
     }
     _ledger_bench_row(row)
@@ -190,6 +204,35 @@ def bench_throughput(
         "bench_step_latency_seconds", "bench throughput per-step latency"
     ).observe(best / steps)
     return row
+
+
+def _resolved_streamk(cfg: SolverConfig, direct: bool = None) -> bool:
+    """Whether this config's superstep resolves to the fused k-sweep
+    streaming kernel (parallel.step._fused_streamk_fn — tb=2..4, TPU or
+    the interpret env, VMEM-feasible slab). Mirrors make_superstep_fn's
+    dispatch ORDER: at tb=2 the no-padded-copy direct2 kernel is
+    preferred, so a row it takes must not be labeled streamk (the two
+    routes have different traffic shapes in the roofline row model).
+    Pass ``direct`` when _resolved_direct was already evaluated — the
+    feasibility walk (env gate + VMEM/tap-stack math) is not free."""
+    from heat3d_tpu.parallel.step import _fused_streamk_fn
+
+    if _fused_streamk_fn(cfg) is None:
+        return False
+    if cfg.time_blocking != 2:
+        return True
+    if direct is None:
+        direct = _resolved_direct(cfg)
+    return not direct
+
+
+def _redundant_frac(cfg: SolverConfig) -> float:
+    """parallel.step.redundant_flops_frac, fail-open to 0.0 only for
+    tb<=1 (where no superstep exists); tb>1 derivation is pure local
+    arithmetic and cannot fail."""
+    from heat3d_tpu.parallel.step import redundant_flops_frac
+
+    return redundant_flops_frac(cfg)
 
 
 def _resolved_fused_dma(cfg: SolverConfig) -> bool:
@@ -378,6 +421,24 @@ def bench_halo(
     )
     for t in times:
         halo_hist.observe(t)
+    # cost-analysis provenance for halo rows (ROADMAP open item): XLA's
+    # bytes for ONE exchange via the `halo_exchange` phase program, so the
+    # halo p50 gets its own achieved-vs-peak fraction in `obs roofline` /
+    # `obs summary` without joining against a throughput row. Same
+    # fail-soft posture as the throughput cost fields.
+    halo_cost = {"cost_bytes_per_step": None}
+    try:
+        from heat3d_tpu.obs.perf.roofline import (
+            cost_analysis_enabled,
+            halo_cost_fields,
+        )
+
+        if cost_analysis_enabled():
+            halo_cost.update(halo_cost_fields(cfg))
+    except Exception as e:  # noqa: BLE001 - telemetry fails soft
+        halo_cost["cost_analysis_error"] = (
+            f"{type(e).__name__}: {str(e)[:120]}"
+        )
     row = {
         "bench": "halo",
         "ts": _utc_now(),
@@ -397,6 +458,7 @@ def bench_halo(
         "rtt_dominated": rtt_dominated,
         "ici": cfg.mesh.num_devices > 1,
         "halo_bytes_per_device": bytes_per_dev,
+        **halo_cost,
     }
     _ledger_bench_row(row)
     return row

@@ -210,9 +210,16 @@ class SolverConfig:
     halo: str = "ppermute"
     # Updates per ghost exchange in the fixed-step loop (temporal blocking):
     # k > 1 exchanges width-k halos and applies the stencil k times per
-    # superstep, cutting ICI messages k-fold; k == 2 additionally fuses both
-    # applications into one HBM sweep via a Pallas kernel. k == 0 means
-    # "auto": resolve through the tuning cache (static fallback 1).
+    # superstep, cutting ICI messages k-fold; for 2 <= k <= 4 on TPU the k
+    # applications additionally fuse into ONE HBM sweep via a Pallas
+    # kernel (the no-padded-copy direct2 kernel where its k=2 scope
+    # applies, else the k-sweep streaming kernel with shrinking ghost
+    # rings resident in VMEM).
+    # Deeper k pays growing redundant ring recompute — bench rows carry
+    # `cost_redundant_flops_frac` so that trade is measured, not assumed
+    # (docs/TUNING.md "Deep temporal blocking"). k == 0 means "auto":
+    # resolve through the tuning cache (static fallback 1). The superstep
+    # needs local extents >= max(3, k) (validated at step-build time).
     time_blocking: int = 1
     # Halo-exchange ordering: 'axis' (x -> y -> z, each axis operating on
     # the array already padded by previous axes — propagates edge/corner

@@ -155,18 +155,51 @@ def roofline_lines(events: List[Dict[str, Any]]) -> List[str]:
     lines: List[str] = []
     try:
         for r in events:
-            if r.get("event") == "bench_row" and (
-                isinstance(r.get("cost_flops_per_step"), (int, float))
-                or isinstance(r.get("cost_bytes_per_step"), (int, float))
+            if r.get("event") != "bench_row":
+                continue
+            grid = "x".join(str(g) for g in (r.get("grid") or []))
+            if r.get("bench") == "halo" and isinstance(
+                r.get("cost_bytes_per_step"), (int, float)
+            ):
+                # halo rows carry their own exchange-program bytes
+                # (ROADMAP "cost-analysis fields for halo rows"): the p50
+                # divides them directly — no throughput-row join needed.
+                # rtt_dominated rows are excluded, matching `obs regress`:
+                # their p50 is mostly dispatch overhead, so bytes/p50
+                # would claim an absurd fraction of peak
+                p50 = r.get("p50_us")
+                if (
+                    isinstance(p50, (int, float))
+                    and p50 > 0
+                    and not r.get("rtt_dominated")
+                ):
+                    line = _achieved_line(
+                        f"halo {grid} p50",
+                        None,
+                        r.get("cost_bytes_per_step"),
+                        p50 * 1e-6,
+                        str(r.get("platform", "?")),
+                    )
+                    if line:
+                        lines.append(line)
+                continue
+            if isinstance(r.get("cost_flops_per_step"), (int, float)) or (
+                isinstance(r.get("cost_bytes_per_step"), (int, float))
             ):
                 steps = r.get("steps")
                 sec = r.get("seconds_best")
                 if isinstance(steps, int) and steps > 0 and isinstance(
                     sec, (int, float)
                 ):
-                    grid = "x".join(str(g) for g in (r.get("grid") or []))
+                    tb = r.get("time_blocking", 1)
+                    label = f"bench {grid} tb={tb}"
+                    frac = r.get("cost_redundant_flops_frac")
+                    if isinstance(frac, (int, float)) and frac > 0:
+                        # deep-tb rows: flag how much of the raw rate is
+                        # ghost-ring recompute, not simulated progress
+                        label += f" ({frac:.0%} recompute)"
                     line = _achieved_line(
-                        f"bench {grid} tb={r.get('time_blocking', 1)}",
+                        label,
                         r.get("cost_flops_per_step"),
                         r.get("cost_bytes_per_step"),
                         sec / steps,

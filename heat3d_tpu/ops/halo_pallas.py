@@ -12,7 +12,11 @@ machinery).
 
 Exchange structure mirrors parallel.halo: one kernel per mesh axis,
 axis-ordered so edge/corner ghosts propagate (27-point stencil support),
-width-k slabs so temporal blocking composes (k ghost rings per exchange).
+width-k slabs so temporal blocking composes (k ghost rings per exchange
+— the deep-tb supersteps at k = 3..4 ride this same slab path, feeding
+either the jnp ring recompute or the fused k-sweep streamk kernel;
+interpret-certified at widths 1..4 on the 1D ring,
+tests/multidevice_checks.py).
 Faces are staged axis-leading — shape (k, A, B) with the two in-plane dims
 as the (sublane, lane) pair — the device-side analogue of the reference's
 pack kernels; staging is what keeps a width-k z-face from degenerating into

@@ -936,7 +936,10 @@ def apply_superstep_fused_dma(
             collective_id=_COLLECTIVE_ID_TB2,
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * 2 * len(flat) * nx * ny * nz,
+            # RAW flops (the streamk convention — see obs/perf/roofline's
+            # effective discount): mids sweep the one-ring-padded volume
+            flops=2 * len(flat)
+            * ((nx + 2) * (ny + 2) * (nz + 2) + nx * ny * nz),
             bytes_accessed=nx * ny * nz
             * (u.dtype.itemsize + jnp.dtype(out_dtype).itemsize),
             transcendentals=0,

@@ -127,10 +127,10 @@ def pallas_supported(cfg: SolverConfig) -> Tuple[bool, str]:
     ):
         # same gate as parallel.step._direct_kernel_fn: only report the
         # direct kernel as support when the dispatch will actually take it
-        # for EVERY step shape this config runs (tb>=3 supersteps fall back
-        # to the padded compute, so they can't ride the direct kernel), else
-        # large single-shard configs would trace into the (infeasible)
-        # windowed kernel instead of falling back
+        # for EVERY step shape this config runs (tb>=3 supersteps ride the
+        # fused streamk kernel or the padded compute, never the direct
+        # kernel), else large single-shard configs would trace into the
+        # (infeasible) windowed kernel instead of falling back
         from heat3d_tpu.ops.stencil_pallas_direct import direct_supported
 
         d1 = direct_supported(
@@ -455,7 +455,13 @@ def apply_taps_pallas_stream2(
     """Fused two-update Pallas stencil: width-2 ghost-padded
     (nx+4, ny+4, nz+4) block in, (nx, ny, nz) double-updated interior out.
     Must run inside shard_map over mesh_axis_names (size-1 axes included) so
-    the kernel can detect domain edges for Dirichlet ghost pinning."""
+    the kernel can detect domain edges for Dirichlet ghost pinning.
+
+    NOTE: production dispatch (parallel.step._fused_streamk_fn) now routes
+    tb=2 through :func:`apply_taps_pallas_streamk` with k=2 — the same
+    ring structure and slot arithmetic, generalized. This specialization
+    stays as the readable two-stage form and the cross-check the streamk
+    tests certify against."""
     nx, ny, nz = up2.shape[0] - 4, up2.shape[1] - 4, up2.shape[2] - 4
     out_dtype = out_dtype or up2.dtype
     compute_dtype = jnp.dtype(compute_dtype).type
@@ -486,13 +492,262 @@ def apply_taps_pallas_stream2(
             pltpu.VMEM((3, ny + 2, nz + 2), up2.dtype),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=2 * 2 * len(flat) * nx * ny * nz,
+            # RAW flops (the streamk convention): the mid stage sweeps the
+            # one-ring-padded volume, so the recompute trapezoid is what
+            # executes — obs/perf/roofline discounts by the analytic frac
+            # to get effective flops, which double-counts if this
+            # estimate were effective-only
+            flops=2 * len(flat)
+            * ((nx + 2) * (ny + 2) * (nz + 2) + nx * ny * nz),
             bytes_accessed=(nx + 4) * (ny + 4) * (nz + 4) * up2.dtype.itemsize
             + nx * ny * nz * jnp.dtype(out_dtype).itemsize,
             transcendentals=0,
         ),
         interpret=interpret,
     )(up2)
+
+
+def _streamk_vmem_bytes(
+    shape: Tuple[int, int, int], k: int, in_itemsize: int, out_itemsize: int
+) -> int:
+    """VMEM footprint of the fused k-sweep kernel: width-k input ring (3)
+    + its pipeline (2), one 3-slot intermediate ring per inner stage
+    (widths shrink by one ghost ring per stage), output pipeline (2)."""
+    ny, nz = shape[1], shape[2]
+
+    def plane(r):
+        return (
+            _round_up(ny + 2 * r, _SUBLANE)
+            * _round_up(nz + 2 * r, _LANE)
+            * in_itemsize
+        )
+
+    mids = sum(3 * plane(r) for r in range(1, k))  # stages 1..k-1
+    plane_o = _round_up(ny, _SUBLANE) * _round_up(nz, _LANE) * out_itemsize
+    return 5 * plane(k) + mids + 2 * plane_o
+
+
+def streamk_supported(
+    shape: Tuple[int, int, int],
+    k: int,
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    n_taps: int = 7,
+    compute_itemsize: int = 4,
+) -> bool:
+    """Can the fused k-sweep streaming kernel run a (nx, ny, nz) local
+    block? Mirrors stream2_supported's two ceilings: the explicit
+    ring/pipeline buffers and Mosaic's scoped stack for the widest
+    emitted plane (stage 1's, carrying k-1 ghost rings)."""
+    if k < 2:
+        return False
+    ny, nz = shape[1], shape[2]
+    return (
+        min(shape) >= k
+        and _streamk_vmem_bytes(shape, k, in_itemsize, out_itemsize)
+        <= 13 * 1024 * 1024
+        and _tap_stack_bytes(
+            ny + 2 * (k - 1), nz + 2 * (k - 1), n_taps, compute_itemsize
+        )
+        <= _TAP_STACK_BUDGET
+    )
+
+
+def _streamk_kernel(
+    in_ref,
+    out_ref,
+    *rings,
+    taps_flat,
+    k,
+    nx,
+    ny,
+    nz,
+    compute_dtype,
+    storage_dtype,
+    out_dtype,
+    periodic,
+    bc_value,
+    axis_names,
+):
+    """Fused k-update streaming stencil (deep temporal blocking) — the
+    k-sweep generalization of _stream2_kernel.
+
+    Uniform coordinate scheme: stage 0 is the width-k-padded input stream
+    (planes 0 .. nx+2k-1), stage j (1 <= j <= k) holds planes carrying
+    r = k-j ghost rings, each (ny+2r, nz+2r); stage-j plane p lives in
+    ring slot p % 3, and at grid step i stage j produces its plane
+    i - 2j from stage j-1's planes (i-2j, i-2j+1, i-2j+2) — the standard
+    3-plane emit shifted by 2 per stage, so the trapezoid of shrinking
+    ghost rings streams through VMEM with every HBM plane fetched once
+    and the k updates fused into one sweep. Stage k writes out_ref.
+
+    Dirichlet intermediates are pinned exactly as the unfused sequence's
+    _fill_mid_ghosts sees them — every cell whose GLOBAL index falls
+    outside the domain (up to r rings deep at domain-edge shards) holds
+    bc_value, and each intermediate round-trips through the storage
+    dtype — so fused == unfused bitwise on the jnp contract.
+    """
+    i = pl.program_id(0)
+    bc = compute_dtype(bc_value)
+
+    def edges(axis_name):
+        from heat3d_tpu.utils.compat import axis_size
+
+        idx = jax.lax.axis_index(axis_name)
+        size = axis_size(axis_name)
+        return idx == 0, idx == size - 1
+
+    x_lo, x_hi = edges(axis_names[0])
+    y_lo, y_hi = edges(axis_names[1])
+    z_lo, z_hi = edges(axis_names[2])
+
+    for kk in range(3):
+
+        @pl.when(jax.lax.rem(i, 3) == kk)
+        def _load(kk=kk):
+            rings[0][kk] = in_ref[0]
+
+    def _pin_out_of_domain(plane, m, r):
+        """bc-pin the out-of-domain cells of a stage plane: plane index
+        ``m`` (stage coords: local x = m - r), in-plane rows/cols with
+        local y/z outside [0, n), at domain-edge shards only."""
+        ghost_plane = jnp.logical_or(
+            jnp.logical_and(m < r, x_lo),
+            jnp.logical_and(m >= nx + r, x_hi),
+        )
+        row = jax.lax.broadcasted_iota(jnp.int32, (ny + 2 * r, 1), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, nz + 2 * r), 1)
+        ring = jnp.logical_or(
+            jnp.logical_or(
+                jnp.logical_and(row < r, y_lo),
+                jnp.logical_and(row >= ny + r, y_hi),
+            ),
+            jnp.logical_or(
+                jnp.logical_and(col < r, z_lo),
+                jnp.logical_and(col >= nz + r, z_hi),
+            ),
+        )
+        return jnp.where(jnp.logical_or(ghost_plane, ring), bc, plane)
+
+    for j in range(1, k + 1):
+        r = k - j  # ghost rings the stage-j planes still carry
+        fire = i >= 2 * j
+        for kk in range(3):  # kk == i % 3
+
+            @pl.when(jnp.logical_and(fire, jax.lax.rem(i, 3) == kk))
+            def _stage(j=j, r=r, kk=kk):
+                # stage j-1 planes (i-2j, i-2j+1, i-2j+2) in slots p%3
+                slots = {
+                    -1: (kk + j) % 3,
+                    0: (kk + j + 1) % 3,
+                    1: (kk + j + 2) % 3,
+                }
+                src = rings[j - 1]
+                planes = {
+                    d: src[s].astype(compute_dtype) for d, s in slots.items()
+                }
+                res = _plane_taps(
+                    planes, taps_flat, ny + 2 * r, nz + 2 * r, compute_dtype
+                )
+                if j == k:
+                    out_ref[0] = res.astype(out_dtype)
+                else:
+                    if not periodic:
+                        res = _pin_out_of_domain(res, i - 2 * j, r)
+                    # round-trip through storage dtype so fused == unfused
+                    rings[j][(kk + j) % 3] = res.astype(storage_dtype)
+
+
+def streamk_cost_estimate(
+    local_shape: Tuple[int, int, int],
+    k: int,
+    n_taps: int,
+    in_itemsize: int,
+    out_itemsize: int,
+) -> Tuple[int, int]:
+    """(flops, bytes_accessed) of one fused k-sweep call: the RAW
+    trapezoid — stage j applies the taps over the (n+2r)^3 extent it
+    emits (r = k-j shrinking ghost rings), which is what the chip
+    executes; bytes are one width-k padded read + one interior write."""
+    nx, ny, nz = local_shape
+    flops = sum(
+        2
+        * n_taps
+        * (nx + 2 * r)
+        * (ny + 2 * r)
+        * (nz + 2 * r)
+        for r in range(k)
+    )
+    bytes_accessed = (
+        (nx + 2 * k) * (ny + 2 * k) * (nz + 2 * k) * in_itemsize
+        + nx * ny * nz * out_itemsize
+    )
+    return flops, bytes_accessed
+
+
+def apply_taps_pallas_streamk(
+    upk: jax.Array,
+    taps: np.ndarray,
+    k: int,
+    mesh_axis_names=("x", "y", "z"),
+    periodic: bool = False,
+    bc_value: float = 0.0,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused k-update Pallas stencil: width-k ghost-padded
+    (nx+2k, ny+2k, nz+2k) block in, (nx, ny, nz) k-times-updated interior
+    out — one HBM sweep for k temporal-blocking updates (bytes/update cut
+    k-fold vs the single-step kernel, at the cost of the shrinking-ring
+    recompute trapezoid; see streamk_cost_estimate). Must run inside
+    shard_map over mesh_axis_names (size-1 axes included) so the kernel
+    can detect domain edges for Dirichlet ghost pinning."""
+    if k < 2:
+        raise ValueError(f"streamk kernel wants k >= 2, got {k}")
+    nx, ny, nz = (s - 2 * k for s in upk.shape)
+    out_dtype = out_dtype or upk.dtype
+    compute_dtype = jnp.dtype(compute_dtype).type
+    flat = flat_taps(taps)
+    kernel = functools.partial(
+        _streamk_kernel,
+        taps_flat=flat,
+        k=k,
+        nx=nx,
+        ny=ny,
+        nz=nz,
+        compute_dtype=compute_dtype,
+        storage_dtype=upk.dtype,
+        out_dtype=jnp.dtype(out_dtype),
+        periodic=periodic,
+        bc_value=bc_value,
+        axis_names=tuple(mesh_axis_names),
+    )
+    flops, bytes_accessed = streamk_cost_estimate(
+        (nx, ny, nz), k, len(flat), upk.dtype.itemsize,
+        jnp.dtype(out_dtype).itemsize,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(nx + 2 * k,),
+        in_specs=[
+            pl.BlockSpec((1, ny + 2 * k, nz + 2 * k), lambda i: (i, 0, 0))
+        ],
+        out_specs=pl.BlockSpec(
+            (1, ny, nz), lambda i: (jnp.maximum(i - 2 * k, 0), 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((nx, ny, nz), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((3, ny + 2 * r, nz + 2 * r), upk.dtype)
+            for r in range(k, 0, -1)  # input ring (r=k) + stages 1..k-1
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=bytes_accessed,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(upk)
 
 
 def _stencil_kernel(in_ref, out_ref, *, taps, bx, by, nz, compute_dtype, out_dtype):

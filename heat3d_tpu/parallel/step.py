@@ -572,6 +572,83 @@ def _fused_dma_3d_fn(cfg: SolverConfig):
     return apply_step_fused_dma
 
 
+def _fused_streamk_fn(cfg: SolverConfig):
+    """Return the fused k-sweep streaming kernel entry for this config's
+    ``time_blocking = k`` (2 <= k <= 4), or None.
+
+    One width-k ghost exchange, then ONE HBM sweep applies the stencil k
+    times with shrinking ghost rings resident in VMEM
+    (ops/stencil_pallas.apply_taps_pallas_streamk — the k-generalization
+    of the stream2 kernel; at k=2 this IS the exchange-path fused
+    two-update route, dispatched after the no-padded-copy direct2
+    kernel). Gated by the shared ``_kernel_env_gate`` (backend, padding,
+    halo_order, platform/emulation env) plus the kernel's own VMEM
+    feasibility; off-TPU with no emulation env the route stands down and
+    the jnp ring-recompute superstep (_local_stepk) runs instead."""
+    k = cfg.time_blocking
+    if k not in (2, 3, 4):
+        return None
+    if cfg.overlap:
+        # the overlap branch of make_superstep_fn (fused DMA-overlap tb=2
+        # or the mutual-exclusion error) runs before any streamk dispatch
+        return None
+    ok, interpret = _kernel_env_gate(cfg)
+    if not ok:
+        return None
+    try:
+        from heat3d_tpu.ops.stencil_pallas import (
+            apply_taps_pallas_streamk,
+            streamk_supported,
+        )
+    except ImportError:
+        return None
+    itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    n_taps = effective_num_taps(STENCILS[cfg.stencil.kind].weights)
+    c_item = jnp.dtype(cfg.precision.compute).itemsize
+    if not streamk_supported(
+        cfg.local_shape, k, itemsize, itemsize, n_taps, c_item
+    ):
+        return None
+    import functools
+
+    if interpret:
+        return functools.partial(apply_taps_pallas_streamk, interpret=True)
+    return apply_taps_pallas_streamk
+
+
+def superstep_cell_updates(cfg: SolverConfig) -> tuple:
+    """(raw, effective) cell updates ONE superstep call executes per
+    device — the honest accounting of deep temporal blocking's redundant
+    ring recompute.
+
+    ``effective`` is the k useful sweeps over the local block (what the
+    simulation advances); ``raw`` is the recompute trapezoid every
+    tb-superstep implementation pays — application j (of k) updates the
+    (n + 2r)-extent slab still carrying r = k-1-j ghost rings, whether
+    as jnp ring recompute (_local_stepk), the fused streaming kernels'
+    in-VMEM stages, or the direct kernels' synthesized-ghost sweeps. At
+    k <= 1 raw == effective. Fractions derived from these are
+    scale-free per device, so they also describe the whole mesh."""
+    k = max(1, cfg.time_blocking)
+    nx, ny, nz = cfg.local_shape
+    effective = k * nx * ny * nz
+    raw = sum(
+        (nx + 2 * r) * (ny + 2 * r) * (nz + 2 * r) for r in range(k)
+    )
+    return raw, effective
+
+
+def redundant_flops_frac(cfg: SolverConfig) -> float:
+    """Fraction of a superstep's executed stencil FLOPs that are
+    redundant ghost-ring recompute (0.0 at time_blocking <= 1) — the
+    ``cost_redundant_flops_frac`` bench-row field and the roofline
+    report's raw-vs-effective discount. A tb=k "win" whose measured
+    Gcell/s rides mostly on this recompute is visible as a large frac
+    next to a modest effective rate."""
+    raw, effective = superstep_cell_updates(cfg)
+    return 0.0 if raw <= effective else 1.0 - effective / raw
+
+
 def _fused_dma2_fn(cfg: SolverConfig):
     """The tb=2 analogue of _fused_dma_fn: the fused two-update superstep
     with the width-2 halo DMA overlapped under the phase-A sweep, for
@@ -881,10 +958,16 @@ def make_superstep_fn(
             "x-slab mesh with >= 2 devices, local nx >= 4, unpadded "
             "shards, on TPU"
         )
-    if min(cfg.local_shape) < cfg.time_blocking:
+    # k ghost layers must fit the local block AND the shrinking-ring
+    # intermediates need a genuine interior to recompute into: below 3
+    # cells per axis a superstep's first application already consumes the
+    # whole block (the same floor the overlap split enforces)
+    min_extent = max(3, cfg.time_blocking)
+    if min(cfg.local_shape) < min_extent:
         raise ValueError(
             f"time_blocking={cfg.time_blocking} needs local extents >= "
-            f"{cfg.time_blocking}, got {cfg.local_shape}"
+            f"{min_extent} (k ghost layers plus the shrinking recompute "
+            f"rings), got {cfg.local_shape}"
         )
     taps = _solver_taps(cfg)
     spec = P(*cfg.mesh.axis_names)
@@ -929,50 +1012,47 @@ def make_superstep_fn(
                 check_vma=False,
             )
 
-    # For k=2, prefer the fused two-update Pallas kernel (both stencil
-    # applications in one HBM sweep); otherwise k compute_padded
-    # applications (which still cuts the exchanges k-fold).
-    fused = None
-    if cfg.time_blocking == 2 and cfg.backend in ("pallas", "auto") and not cfg.is_padded:
-        try:
-            from heat3d_tpu.ops.stencil_pallas import (
-                apply_taps_pallas_stream2,
-                stream2_supported,
-            )
+    # The fused k-sweep streaming kernel (k=2..4): keeps the width-k
+    # padded slab resident in VMEM and applies the stencil k times with
+    # shrinking ghost rings — one exchange AND one HBM sweep per k
+    # updates. Composes with either exchange transport (ppermute or the
+    # width-k DMA slab kernels); stands down (jnp ring recompute below)
+    # off-TPU or when the slab busts the VMEM gate. k=2 reaches here only
+    # when the direct2 kernel above didn't dispatch (its no-padded-copy
+    # form is strictly better in that scope).
+    fusedk = _fused_streamk_fn(cfg)
+    if fusedk is not None:
+        k = cfg.time_blocking
+        _log_step_path_once(
+            "superstep path: fused %d-sweep streaming kernel (width-%d "
+            "slab resident in VMEM, shrinking-ring recompute)%s"
+            % (k, k, " [interpret]" if _kernel_env_gate(cfg)[1] else "")
+        )
+        periodic_k = cfg.stencil.bc is BoundaryCondition.PERIODIC
 
-            itemsize = jnp.dtype(cfg.precision.storage).itemsize
-            n_taps = effective_num_taps(STENCILS[cfg.stencil.kind].weights)
-            c_item = jnp.dtype(cfg.precision.compute).itemsize
-            if (
-                jax.devices()[0].platform == "tpu"
-                and stream2_supported(
-                    cfg.local_shape, itemsize, itemsize, n_taps, c_item
-                )
-            ):
-                fused = apply_taps_pallas_stream2
-        except ImportError:
-            pass
-
-    if fused is not None:
-        periodic = cfg.stencil.bc is BoundaryCondition.PERIODIC
-
-        def local(u_local):
-            up2 = exchange(u_local, cfg, width=2)
+        def localk(u_local):
+            upk = exchange(u_local, cfg, width=k)
             with named_phase("stencil"):
-                return fused(
-                    up2,
+                return fusedk(
+                    upk,
                     taps,
+                    k,
                     mesh_axis_names=cfg.mesh.axis_names,
-                    periodic=periodic,
+                    periodic=periodic_k,
                     bc_value=cfg.stencil.bc_value,
                     compute_dtype=jnp.dtype(cfg.precision.compute),
                     out_dtype=jnp.dtype(cfg.precision.storage),
                 )
 
-    else:
+        return shard_map(
+            localk, mesh=mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
 
-        def local(u_local):
-            return _local_stepk(u_local, taps, cfg, compute_padded)
+    # Fallback: k compute_padded applications with jnp ring recompute —
+    # still cuts the exchanges k-fold, runs anywhere.
+    def local(u_local):
+        return _local_stepk(u_local, taps, cfg, compute_padded)
 
     return shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False

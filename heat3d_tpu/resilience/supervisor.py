@@ -478,6 +478,23 @@ def run_supervised(
             rec_record = recoveries[-1].to_record()
             rec_record["kind_"] = rec_record.pop("kind")  # envelope owns kind
             ledger.event("recovery", **rec_record)
+            if make_solver is not None:
+                # the rebuilt solver may have landed on different hardware
+                # or a different mesh (cross-mesh stitch-resume), where its
+                # compiled step program — and therefore its cost model —
+                # differs from the one recorded at run start. Re-emit
+                # step_cost so post-heal throughput is judged against the
+                # program that is NOW running (ROADMAP "supervised-path
+                # step_cost"). Fails soft and no-ops without a ledger; the
+                # extra step-program compile is paid once per recovery.
+                try:
+                    from heat3d_tpu.obs.perf.roofline import record_step_cost
+
+                    record_step_cost(solver, post_heal=True, step=done)
+                except Exception as rexc:  # noqa: BLE001 - telemetry only
+                    log.warning(
+                        "post-heal step_cost re-record unavailable: %s", rexc
+                    )
             obs.REGISTRY.counter(
                 "recoveries_total", "survived supervised failures"
             ).inc(kind=kind)

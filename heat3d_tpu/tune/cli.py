@@ -191,6 +191,14 @@ def _entry_lines(key: str, e: dict) -> str:
         and default > 0
         else "speedup n/a"
     )
+    tb = cfg.get("time_blocking")
+    if isinstance(tb, int) and tb > 1:
+        # temporal-blocking winners: say what the speedup bought and what
+        # it cost — k-fold fewer exchanges, paid in ghost-ring recompute
+        # (the measured metric already includes that tax; the bench row's
+        # cost_redundant_flops_frac quantifies it per shape)
+        speed += f"; tb={tb} winner ({tb}x fewer exchanges, ring recompute"
+        speed += " priced in)"
     return (
         f"{key}\n"
         f"    config: {_fmt_knobs(cfg)}\n"

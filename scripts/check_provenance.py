@@ -47,6 +47,8 @@ ROUTE_FIELDS = (
     "mehrstellen_route",
     "fused_dma_path",
     "fused_dma_emulated",
+    "streamk_path",
+    "streamk_emulated",
 )
 MAX_REPORT = 20
 
@@ -67,6 +69,19 @@ def check_row(r: dict) -> list:
         elif r["chain_ops"] is None and r.get("backend") != "conv":
             problems.append(
                 "chain_ops is null on a non-conv row (op-count provenance "
+                "lost)"
+            )
+        # temporally-blocked rows execute redundant ghost-ring recompute;
+        # without the recorded fraction their Gcell/s cannot be discounted
+        # to useful work at judging time (deep-tb honesty — a tb=4 "win"
+        # must carry its own recompute tax on the row)
+        tb = r.get("time_blocking", 1)
+        if isinstance(tb, int) and tb > 1 and not isinstance(
+            r.get("cost_redundant_flops_frac"), (int, float)
+        ):
+            problems.append(
+                "cost_redundant_flops_frac missing/non-numeric on a "
+                f"time_blocking={tb} row (redundant-compute provenance "
                 "lost)"
             )
     elif r.get("bench") == "halo":

@@ -242,16 +242,17 @@ python -m heat3d_tpu.obs.cli regress "$OUT" --start-line "$LINT_FROM" \
   --json | tee -a "$SUITE_LOG"
 
 # Autotune smoke + cache-schema lint (informational, AFTER the gates so
-# their rc still decides the suite): a budgeted 2-knob `tune run` proves
-# the search-measure-decide-cache loop stays alive end to end — on CPU
-# its numbers are smoke, not record, so it writes a session-local store
-# (never the operator's ~/.cache default) and both steps fail SOFT.
-# SKIP_TUNE_SMOKE=1 skips; docs/TUNING.md.
+# their rc still decides the suite): a budgeted `tune run` over the FULL
+# extended time_blocking lattice (1..4 — deep tb included, so the
+# search-measure-decide-cache loop AND the deep-tb validity pruning stay
+# alive end to end) — on CPU its numbers are smoke, not record, so it
+# writes a session-local store (never the operator's ~/.cache default)
+# and both steps fail SOFT. SKIP_TUNE_SMOKE=1 skips; docs/TUNING.md.
 if [[ -z "${SKIP_TUNE_SMOKE:-}" ]]; then
   TUNE_CACHE="${TUNE_CACHE:-${OUT%.jsonl}.tune_cache.json}"
   python -m heat3d_tpu.cli tune run --grid "${TUNE_GRID:-24}" \
     --steps "${TUNE_STEPS:-8}" --repeats 1 --probe-steps 4 \
-    --budget-s "${TUNE_BUDGET_S:-30}" --knob time_blocking=1,2 \
+    --budget-s "${TUNE_BUDGET_S:-45}" --knob time_blocking=1,2,3,4 \
     --cache "$TUNE_CACHE" --json >> "$SUITE_LOG" 2>&1 \
     || note "suite: tune smoke failed (rc=$?) — informational"
   python -m heat3d_tpu.cli tune lint --cache "$TUNE_CACHE" \

@@ -491,13 +491,14 @@ def check_dma_halo_ring_interpret():
     from heat3d_tpu.parallel.halo import exchange_axis
 
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
-    base = (24, 24, 24)  # 3 cells/shard on the ring axis: admits width 3
+    # 4 cells/shard on the ring axis: admits width 4 (the deep-tb slab)
+    base = (32, 32, 32)
     u_host = golden.random_init(base, seed=3)
     for axis in range(3):
         spec = P(*["x" if a == axis else None for a in range(3)])
         u = jax.device_put(jnp.asarray(u_host), NamedSharding(mesh, spec))
         for periodic in (True, False):
-            for width in (1, 2, 3):
+            for width in (1, 2, 3, 4):
                 got = jax.jit(
                     shard_map(
                         lambda x: exchange_axis_dma(
@@ -521,7 +522,7 @@ def check_dma_halo_ring_interpret():
                     np.asarray(got), np.asarray(want),
                     err_msg=f"axis={axis} periodic={periodic} width={width}",
                 )
-    print("dma_halo_ring_interpret OK (axes 0-2, widths 1-3)")
+    print("dma_halo_ring_interpret OK (axes 0-2, widths 1-4)")
 
 
 def check_fused_dma_overlap_ring_interpret():
@@ -915,7 +916,118 @@ def check_gather_slice_distributed():
     print("gather_slice_distributed OK")
 
 
+def check_deep_tb_tier1():
+    """Tier-1 deep-tb certification on REAL multi-device meshes: the k=3
+    and k=4 supersteps (jnp ring-recompute path — the route every
+    non-TPU platform runs) match k sequential ``make_step_fn`` steps,
+    AND both match the fp64 NumPy golden oracle, with cross-device
+    width-k ppermutes and 2-then-1-ring mid fills actually executing.
+    Focused and fast so test_multidevice.py can run it UNMARKED (tier-1)
+    in a 4-device subprocess."""
+    import dataclasses
+
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    for k, steps, grid, mesh_shape, bc, bcv in (
+        (3, 6, (8, 8, 8), (2, 2, 1), BoundaryCondition.DIRICHLET, 0.5),
+        (3, 3, (12, 8, 8), (4, 1, 1), BoundaryCondition.PERIODIC, 0.0),
+        (4, 5, (8, 8, 8), (2, 2, 1), BoundaryCondition.DIRICHLET, 0.0),
+    ):
+        cfg = SolverConfig(
+            grid=GridConfig(shape=grid),
+            stencil=StencilConfig(bc=bc, bc_value=bcv),
+            mesh=MeshConfig(shape=mesh_shape),
+            backend="jnp",
+        )
+        cfgk = dataclasses.replace(cfg, time_blocking=k)
+        u_host = golden.random_init(grid, seed=23)
+        s1, sk = HeatSolver3D(cfg), HeatSolver3D(cfgk)
+        got = sk.gather(sk.run(sk.init_state(u_host), steps))
+        want = s1.gather(s1.run(s1.init_state(u_host), steps))
+        label = f"k={k} mesh={mesh_shape} bc={bc}"
+        np.testing.assert_allclose(
+            got, want, rtol=1e-6, atol=1e-6,
+            err_msg=f"superstep != sequential steps ({label})",
+        )
+        want64 = golden.run(
+            u_host.astype(np.float64), cfg.grid, cfg.stencil, steps
+        )
+        np.testing.assert_allclose(
+            got, want64, rtol=1e-4, atol=1e-5,
+            err_msg=f"superstep != fp64 golden ({label})",
+        )
+    print("deep_tb_tier1 OK")
+
+
+def check_deep_tb_streamk_interpret():
+    """The fused k-sweep streamk kernel on REAL multi-device meshes via
+    the interpret tier: the kernel's domain-edge detection (axis_index
+    gating in _pin_out_of_domain) must pin intermediate rings ONLY at
+    domain-edge shards and leave exchanged-ghost rings intact at interior
+    shards — a (1,1,1) mesh can't tell those apart (every boundary is a
+    domain edge there). Nonzero Dirichlet bc_value makes a wrong interior
+    pin numerically loud. Parity target: k sequential jnp steps."""
+    import dataclasses
+    import os
+
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+    from heat3d_tpu.parallel.step import _fused_streamk_fn
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("HEAT3D_DIRECT_INTERPRET", "HEAT3D_NO_DIRECT")
+    }
+    os.environ["HEAT3D_DIRECT_INTERPRET"] = "1"
+    os.environ["HEAT3D_NO_DIRECT"] = "1"  # pin the streamk route
+    try:
+        for k, grid, mesh_shape, bc, bcv in (
+            (3, (12, 8, 8), (4, 1, 1), BoundaryCondition.DIRICHLET, 0.5),
+            (4, (8, 8, 8), (2, 2, 1), BoundaryCondition.DIRICHLET, 0.25),
+            (3, (12, 8, 8), (4, 1, 1), BoundaryCondition.PERIODIC, 0.0),
+        ):
+            cfgk = SolverConfig(
+                grid=GridConfig(shape=grid),
+                stencil=StencilConfig(bc=bc, bc_value=bcv),
+                mesh=MeshConfig(shape=mesh_shape),
+                backend="auto",
+                time_blocking=k,
+            )
+            assert _fused_streamk_fn(cfgk) is not None, (
+                f"streamk did not resolve under interpret (k={k})"
+            )
+            cfg1 = dataclasses.replace(
+                cfgk, time_blocking=1, backend="jnp"
+            )
+            u_host = golden.random_init(grid, seed=29)
+            sk, s1 = HeatSolver3D(cfgk), HeatSolver3D(cfg1)
+            got = sk.gather(sk.run(sk.init_state(u_host), k))
+            want = s1.gather(s1.run(s1.init_state(u_host), k))
+            np.testing.assert_allclose(
+                got, want, rtol=1e-6, atol=1e-6,
+                err_msg=(
+                    f"streamk superstep != sequential steps "
+                    f"(k={k} mesh={mesh_shape} bc={bc})"
+                ),
+            )
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+    print("deep_tb_streamk_interpret OK")
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "deep_tb":
+        # focused tier-1 entry (test_multidevice.py runs it unmarked on a
+        # 4-device mesh; the full 8-device battery stays slow-marked)
+        n = len(jax.devices())
+        assert n >= 4, f"expected >= 4 CPU devices, got {n}"
+        check_deep_tb_tier1()
+        check_deep_tb_streamk_interpret()
+        print("ALL MULTIDEVICE CHECKS PASSED")
+        return
     n = len(jax.devices())
     assert n == 8, f"expected 8 CPU devices, got {n} ({jax.devices()})"
     check_step_matches_single_device()

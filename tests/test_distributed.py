@@ -190,6 +190,71 @@ def test_time_blocking_rejects_overlap():
         make_superstep_fn(dataclasses.replace(base, overlap=True), mesh)
 
 
+@pytest.mark.parametrize(
+    "n,k,ok",
+    [
+        (2, 2, False),  # below the 3-cell interior floor
+        (3, 2, True),
+        (3, 3, True),
+        (3, 4, False),  # k ghost layers don't fit
+        (4, 4, True),
+        (4, 5, False),
+    ],
+)
+def test_deep_tb_local_extent_validation(n, k, ok):
+    """The superstep needs local extents >= max(3, k): k ghost layers
+    plus a genuine interior for the shrinking recompute rings."""
+    import dataclasses
+
+    cfg = dataclasses.replace(solo_cfg(n=n), time_blocking=k)
+    mesh = build_mesh(cfg.mesh)
+    if ok:
+        make_superstep_fn(cfg, mesh)  # builds without raising
+    else:
+        with pytest.raises(ValueError, match="needs local extents"):
+            make_superstep_fn(cfg, mesh)
+
+
+def test_pairwise_rejects_deep_tb():
+    """halo_order='pairwise' stays excluded for every tb > 1 — the deep
+    supersteps' shrinking rings read edge/corner ghosts only axis-ordered
+    exchange fills (config validation, shared with the tuner's pruning)."""
+    for k in (2, 3, 4):
+        with pytest.raises(ValueError, match="pairwise"):
+            SolverConfig(
+                grid=GridConfig.cube(8),
+                mesh=MeshConfig(shape=(1, 1, 1)),
+                halo_order="pairwise",
+                time_blocking=k,
+            )
+
+
+def test_superstep_cell_updates_and_redundant_frac():
+    """The trapezoid cost model: raw counts the shrinking-ring recompute,
+    effective the k useful sweeps, and the frac is their honest gap."""
+    import dataclasses
+
+    from heat3d_tpu.parallel.step import (
+        redundant_flops_frac,
+        superstep_cell_updates,
+    )
+
+    cfg1 = solo_cfg(n=8)
+    raw, eff = superstep_cell_updates(cfg1)
+    assert raw == eff == 512 and redundant_flops_frac(cfg1) == 0.0
+    cfg3 = dataclasses.replace(cfg1, time_blocking=3)
+    raw, eff = superstep_cell_updates(cfg3)
+    # applications over 12^3, 10^3, 8^3 vs 3 useful 8^3 sweeps
+    assert raw == 12**3 + 10**3 + 8**3
+    assert eff == 3 * 8**3
+    assert redundant_flops_frac(cfg3) == pytest.approx(1 - eff / raw)
+    # deeper k, larger frac; bigger blocks, smaller frac
+    cfg4 = dataclasses.replace(cfg1, time_blocking=4)
+    assert redundant_flops_frac(cfg4) > redundant_flops_frac(cfg3)
+    big = dataclasses.replace(solo_cfg(n=64), time_blocking=4)
+    assert redundant_flops_frac(big) < redundant_flops_frac(cfg4)
+
+
 def test_residual_psum_replicated():
     cfg = solo_cfg()
     mesh = build_mesh(cfg.mesh)

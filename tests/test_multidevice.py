@@ -32,6 +32,35 @@ def _cpu_mesh_env(ndev: int) -> dict:
     return env
 
 
+def test_deep_tb_on_cpu_mesh_tier1():
+    """Tier-1 (unmarked) deep-tb acceptance: the k=3 and k=4 supersteps
+    match k sequential steps AND the fp64 golden oracle on a REAL
+    4-device CPU mesh — cross-device width-k ppermutes and shrinking
+    mid-ring fills executing, not compile-only — plus the streamk kernel
+    (interpret tier) on the same meshes, certifying its domain-edge ring
+    pinning distinguishes interior shards from domain edges. Focused
+    subprocess (4 devices) so it fits the tier-1 budget; the full
+    8-device battery stays @slow."""
+    env = _cpu_mesh_env(4)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(HERE, "multidevice_checks.py"),
+            "deep_tb",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"deep-tb multidevice check failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    assert "deep_tb_tier1 OK" in proc.stdout
+    assert "deep_tb_streamk_interpret OK" in proc.stdout
+
+
 @pytest.mark.slow
 def test_multidevice_checks_on_cpu_mesh():
     env = _cpu_mesh_env(8)

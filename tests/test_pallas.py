@@ -117,6 +117,142 @@ def test_stream2_interpret_matches_unfused(kind, bc, bcv):
     )
 
 
+@pytest.mark.parametrize("kind", ["7pt", "27pt"])
+@pytest.mark.parametrize(
+    "bc,bcv", [("dirichlet", 0.0), ("dirichlet", 1.5), ("periodic", 0.0)]
+)
+@pytest.mark.parametrize("k", [3, 4])
+def test_streamk_interpret_matches_unfused(kind, bc, bcv, k):
+    """Fused k-sweep kernel == k single applications with shrinking
+    mid-ghost pinning, on a (1,1,1) mesh (every boundary a domain edge).
+    The deep-tb generalization of the stream2 contract."""
+    from jax.sharding import PartitionSpec as P
+
+    from heat3d_tpu.core.config import BoundaryCondition
+    from heat3d_tpu.ops.stencil_pallas import apply_taps_pallas_streamk
+    from heat3d_tpu.parallel.step import _local_stepk, exchange
+    from heat3d_tpu.parallel.topology import build_mesh
+
+    bce = BoundaryCondition(bc)
+    cfg = SolverConfig(
+        grid=GridConfig.cube(8),
+        stencil=StencilConfig(kind=kind, bc=bce, bc_value=bcv),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp",
+        time_blocking=k,
+    )
+    taps = _taps(kind)
+    mesh = build_mesh(cfg.mesh)
+    u = jnp.asarray(
+        np.random.default_rng(11).standard_normal((8, 8, 8)).astype(np.float32)
+    )
+    spec = P("x", "y", "z")
+
+    want = shard_map(
+        lambda x: _local_stepk(x, taps, cfg, apply_taps_padded),
+        mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
+    )(u)
+
+    def fused(x):
+        upk = exchange(x, cfg, width=k)
+        return apply_taps_pallas_streamk(
+            upk, taps, k, ("x", "y", "z"),
+            periodic=bce is BoundaryCondition.PERIODIC,
+            bc_value=bcv, interpret=True,
+        )
+
+    got = shard_map(
+        fused, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )(u)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_streamk_route_stands_down_off_tpu(monkeypatch):
+    """The fused k-sweep route resolves ONLY on TPU (or under the
+    interpret env): off-TPU the resolver returns None and the superstep
+    runs the jnp ring recompute — the dispatch contract of ISSUE 5."""
+    from heat3d_tpu.parallel.step import _fused_streamk_fn
+
+    monkeypatch.delenv("HEAT3D_DIRECT_INTERPRET", raising=False)
+    monkeypatch.delenv("HEAT3D_DIRECT_FORCE", raising=False)
+    cfg = SolverConfig(
+        grid=GridConfig.cube(16), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="auto", time_blocking=3,
+    )
+    fn = _fused_streamk_fn(cfg)
+    if ON_TPU:
+        assert fn is not None
+    else:
+        assert fn is None
+    # tb outside the fused scope (k=2..4) never resolves, anywhere
+    import dataclasses
+
+    assert _fused_streamk_fn(dataclasses.replace(cfg, time_blocking=5)) is None
+    # overlap routes through the fused-DMA branch / mutual exclusion, so
+    # the streamk resolver must stand down for it
+    assert (
+        _fused_streamk_fn(dataclasses.replace(cfg, overlap=True)) is None
+    )
+    # jnp backend pins the exchange path (shared _kernel_env_gate rule)
+    assert _fused_streamk_fn(dataclasses.replace(cfg, backend="jnp")) is None
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_streamk_superstep_route_interpret_end_to_end(monkeypatch, k):
+    """With the interpret env the production make_superstep_fn dispatch
+    selects the streamk kernel, and the full fixed-step loop (supersteps
+    + remainder steps) matches the plain per-step loop."""
+    import dataclasses
+
+    from heat3d_tpu.core import golden
+    from heat3d_tpu.parallel.step import make_multistep_fn
+    from heat3d_tpu.parallel.topology import build_mesh
+
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    monkeypatch.setenv("HEAT3D_NO_DIRECT", "1")  # pin the streamk route
+    cfg = SolverConfig(
+        grid=GridConfig.cube(8), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="auto", time_blocking=k,
+    )
+    from heat3d_tpu.parallel.step import _fused_streamk_fn
+
+    assert _fused_streamk_fn(cfg) is not None  # interpret tier resolves
+    mesh = build_mesh(cfg.mesh)
+    u = jnp.asarray(golden.random_init((8, 8, 8), seed=21))
+    got = jax.jit(make_multistep_fn(cfg, mesh))(u, jnp.int32(k + 1))
+    cfg1 = dataclasses.replace(cfg, time_blocking=1, backend="jnp")
+    want = jax.jit(make_multistep_fn(cfg1, mesh))(u, jnp.int32(k + 1))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+@pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
+@pytest.mark.tpu_smoke
+@pytest.mark.parametrize("k", [3, 4])
+def test_streamk_compiled_on_tpu(k):
+    """Fused k-sweep kernel compiles and matches k jnp steps on hardware
+    (the deep-tb bench path)."""
+    import dataclasses
+
+    from heat3d_tpu.core import golden
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    cfg = SolverConfig(
+        grid=GridConfig.cube(64), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="pallas", time_blocking=k,
+    )
+    cfg1 = dataclasses.replace(cfg, time_blocking=1, backend="jnp")
+    u_host = golden.random_init((64, 64, 64), seed=13)
+    sk = HeatSolver3D(cfg)
+    s1 = HeatSolver3D(cfg1)
+    got = sk.gather(sk.run(sk.init_state(u_host), 2 * k))
+    want = s1.gather(s1.run(s1.init_state(u_host), 2 * k))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 @pytest.mark.skipif(not ON_TPU, reason="needs a real TPU")
 @pytest.mark.tpu_smoke
 def test_stream2_compiled_on_tpu():

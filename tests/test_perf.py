@@ -379,6 +379,110 @@ def test_summary_roofline_section(tmp_path, capsys):
     assert "GB/s" in out
 
 
+def test_step_cost_fields_deep_tb_raw_vs_effective():
+    """Deep-tb cost fields carry the redundant-compute honesty pair: the
+    per-update flops stay RAW (what the chip executes) and the effective
+    side discounts them by the analytic trapezoid frac."""
+    import dataclasses
+
+    from heat3d_tpu.core.config import GridConfig, MeshConfig, SolverConfig
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+    from heat3d_tpu.obs.perf.roofline import step_cost_fields
+    from heat3d_tpu.parallel.step import redundant_flops_frac
+
+    cfg = SolverConfig(
+        grid=GridConfig.cube(8), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp", time_blocking=3,
+    )
+    f = step_cost_fields(HeatSolver3D(cfg))
+    frac = redundant_flops_frac(cfg)
+    assert 0.0 < frac < 1.0
+    assert f["cost_redundant_flops_frac"] == frac
+    assert f["cost_effective_flops_per_step"] == pytest.approx(
+        f["cost_flops_per_step"] * (1 - frac)
+    )
+    f1 = step_cost_fields(
+        HeatSolver3D(dataclasses.replace(cfg, time_blocking=1))
+    )
+    assert f1["cost_redundant_flops_frac"] == 0.0
+    assert f1["cost_effective_flops_per_step"] == f1["cost_flops_per_step"]
+
+
+def test_bench_rows_carry_redundant_frac_and_halo_bytes(tmp_path):
+    """tb>1 throughput rows carry cost_redundant_flops_frac (required by
+    scripts/check_provenance.py), halo rows carry the exchange program's
+    cost_bytes_per_step, and the provenance lint enforces the tb>1 rule."""
+    import dataclasses
+
+    from heat3d_tpu.bench.harness import bench_halo, bench_throughput
+    from heat3d_tpu.core.config import GridConfig, MeshConfig, SolverConfig
+
+    cfg = SolverConfig(
+        grid=GridConfig.cube(8), mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp", time_blocking=2,
+    )
+    row = bench_throughput(cfg, steps=2, warmup=1, repeats=1)
+    assert row["cost_redundant_flops_frac"] > 0
+    assert row["streamk_path"] is False  # jnp backend pins the exchange path
+    assert row["streamk_emulated"] is False
+    halo = bench_halo(
+        dataclasses.replace(cfg, time_blocking=1), iters=2, warmup=1, k=2
+    )
+    assert halo["cost_bytes_per_step"] > 0
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_provenance as cp
+    finally:
+        sys.path.pop(0)
+    assert cp.check_row(row) == []
+    assert cp.check_row(halo) == []
+    broken = dict(row)
+    broken.pop("cost_redundant_flops_frac")
+    assert any(
+        "cost_redundant_flops_frac" in p for p in cp.check_row(broken)
+    )
+    # tb=1 rows are exempt (the committed legacy record predates the field)
+    tb1 = dict(row)
+    tb1["time_blocking"] = 1
+    tb1.pop("cost_redundant_flops_frac")
+    assert cp.check_row(tb1) == []
+
+
+def test_summary_roofline_halo_and_recompute_lines(tmp_path, capsys):
+    """obs summary's roofline section prints (a) the halo p50's own
+    achieved-vs-peak line from a halo bench_row's cost bytes and (b) the
+    recompute discount on deep-tb throughput rows."""
+    from heat3d_tpu.obs.cli import main as obs_main
+
+    led = str(tmp_path / "led.jsonl")
+    ledger = obs.activate(led)
+    ledger.event(
+        "bench_row", bench="halo", platform="cpu", grid=[32, 32, 32],
+        p50_us=100.0, cost_bytes_per_step=2.0e6,
+    )
+    # rtt-dominated halo rows are excluded (the `obs regress` convention:
+    # their p50 is dispatch overhead, not transport) — must NOT print
+    ledger.event(
+        "bench_row", bench="halo", platform="cpu", grid=[16, 16, 16],
+        p50_us=5.0, cost_bytes_per_step=2.0e6, rtt_dominated=True,
+    )
+    ledger.event(
+        "bench_row", bench="throughput", platform="cpu",
+        grid=[32, 32, 32], time_blocking=4, steps=10, seconds_best=0.1,
+        cost_flops_per_step=1.0e9, cost_bytes_per_step=2.0e9,
+        cost_redundant_flops_frac=0.25,
+    )
+    obs.deactivate()
+    rc = obs_main(["summary", led])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "roofline halo 32x32x32 p50 [cpu]" in out
+    assert "20.00 GB/s" in out  # 2e6 B / 100 us
+    assert "halo 16x16x16" not in out  # rtt_dominated: excluded
+    assert "tb=4 (25% recompute)" in out
+
+
 # ---- profiling capture ----------------------------------------------------
 
 

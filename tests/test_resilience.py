@@ -314,6 +314,53 @@ def test_supervised_backend_loss_resumes_bitwise(tmp_path):
     assert gens == ["gen-00000008", "gen-00000012"]
 
 
+def test_supervised_recovery_rerecords_step_cost(tmp_path):
+    """A recovery that REBUILDS the solver (make_solver) re-emits the
+    step_cost ledger event tagged post_heal, so post-heal throughput is
+    judged against the rebuilt program's cost model (ROADMAP
+    'supervised-path step_cost'); the default reuse path does not."""
+    from heat3d_tpu import obs
+    from heat3d_tpu.resilience.supervisor import run_supervised
+
+    led = str(tmp_path / "led.jsonl")
+    obs.activate(led)
+    plan = FaultPlan(_parse_spec("backend-loss:step=4"))
+    res = run_supervised(
+        tiny_solver(), 8, str(tmp_path / "ck"), checkpoint_every=4,
+        heal_policy=FAST_HEAL, probe=lambda: "cpu", faults=plan,
+        make_solver=tiny_solver,
+    )
+    obs.deactivate()
+    assert res.steps_done == 8 and len(res.recoveries) == 1
+    evs = [
+        json.loads(line)
+        for line in open(led)
+        if line.strip()
+    ]
+    costs = [
+        e
+        for e in evs
+        if e.get("event") == "step_cost" and e.get("post_heal")
+    ]
+    assert len(costs) == 1
+    c = costs[0]
+    assert c["ok"] is True and c["step"] == 4
+    assert c["cost_flops_per_step"] > 0
+    # the reuse path (no make_solver) emits no post-heal event
+    led2 = str(tmp_path / "led2.jsonl")
+    obs.activate(led2)
+    run_supervised(
+        tiny_solver(), 8, str(tmp_path / "ck2"), checkpoint_every=4,
+        heal_policy=FAST_HEAL, probe=lambda: "cpu",
+        faults=FaultPlan(_parse_spec("backend-loss:step=4")),
+    )
+    obs.deactivate()
+    evs2 = [json.loads(line) for line in open(led2) if line.strip()]
+    assert not any(
+        e.get("event") == "step_cost" and e.get("post_heal") for e in evs2
+    )
+
+
 def test_supervised_hang_trips_watchdog_and_recovers(tmp_path):
     from heat3d_tpu.resilience.supervisor import run_supervised
 
@@ -568,6 +615,7 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
         "bench": "throughput", "ts": "2026-01-01T00:00:00Z",
         "platform": "tpu", "direct_path": True, "mehrstellen_route": False,
         "fused_dma_path": False, "fused_dma_emulated": False,
+        "streamk_path": False, "streamk_emulated": False,
         "chain_ops": 7, "backend": "auto", "sync_rtt_s": 7.5e-2,
     }
     halo_good = {

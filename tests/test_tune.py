@@ -152,6 +152,45 @@ def test_space_prunes_invalid_and_unsupported():
     assert get("ppermute", "pairwise", 1).prune is None
 
 
+def test_space_searches_deep_tb_and_prunes_invalid():
+    """The default lattice searches time_blocking in {1,2,3,4}; deep-tb
+    candidates whose local extents cannot carry the k ghost layers are
+    pruned with the PRODUCTION superstep error, and pairwise+deep-tb
+    falls to config validation."""
+    assert tspace.DEFAULT_KNOBS["time_blocking"] == (1, 2, 3, 4)
+    # 2^3 grid on a (1,1,1) mesh: local extents 2 — every superstep depth
+    # fails the max(3, k) floor through the real solver build
+    base = _cfg(2, backend="jnp")
+    cands = tspace.enumerate_candidates(base, {"time_blocking": (1, 2, 3, 4)})
+    by_tb = {c.knobs["time_blocking"]: c for c in cands}
+    assert by_tb["1"].prune is None
+    for tb in ("2", "3", "4"):
+        assert "needs local extents" in (by_tb[tb].prune or ""), by_tb[tb]
+    # ample extents: deep tb is measurable on the jnp path anywhere
+    cands8 = tspace.enumerate_candidates(
+        _cfg(backend="jnp"), {"time_blocking": (3, 4)}
+    )
+    assert all(c.prune is None for c in cands8)
+    # pairwise + deep tb: structurally invalid at config validation
+    pw = tspace.enumerate_candidates(
+        _cfg(backend="jnp"),
+        {"halo_order": ("pairwise",), "time_blocking": (3,)},
+    )
+    deep = [
+        c
+        for c in pw
+        if c.knobs.get("halo_order") == "pairwise"
+        and c.knobs.get("time_blocking") == "3"
+    ]
+    assert deep and all(
+        (c.prune or "").startswith("invalid:") for c in deep
+    )
+
+
+def test_parse_knob_values_deep_tb():
+    assert tspace.parse_knob_values("time_blocking", "1,2,3,4") == (1, 2, 3, 4)
+
+
 def test_space_prunes_pairwise_for_27pt():
     base = _cfg(backend="jnp", stencil=StencilConfig(kind="27pt"))
     cands = tspace.enumerate_candidates(
