@@ -1,0 +1,32 @@
+"""Static-analysis subsystem: SPMD-safety and invariant lints.
+
+Five AST/arithmetic checkers over the repo's own source (docs/ANALYSIS.md
+is the catalog), one shared finding/severity/suppression framework
+(:mod:`~heat3d_tpu.analysis.findings`), and the promoted data-lint cores
+behind ``scripts/check_ledger.py`` / ``scripts/check_provenance.py``.
+``heat3d lint`` (:mod:`~heat3d_tpu.analysis.cli`) is the operator/CI
+entry point: rc 1 only on unsuppressed error-severity findings.
+
+The checkers parse, they do not import, the code they audit — except
+where the arithmetic itself is the artifact under audit (VMEM budget
+estimators, the live knob surfaces), which is loaded deliberately.
+"""
+
+from __future__ import annotations
+
+from heat3d_tpu.analysis.findings import (  # noqa: F401
+    ERROR,
+    INFO,
+    WARNING,
+    Finding,
+)
+
+# checker name -> module path (the CLI resolves lazily so `heat3d lint
+# --checker vmem-budget` does not import jax-heavy modules it won't run)
+CHECKERS = {
+    "collective-divergence": "heat3d_tpu.analysis.collectives",
+    "fail-soft": "heat3d_tpu.analysis.failsoft",
+    "vmem-budget": "heat3d_tpu.analysis.vmem",
+    "ledger-taxonomy": "heat3d_tpu.analysis.taxonomy",
+    "knob-drift": "heat3d_tpu.analysis.knobs",
+}

@@ -1,0 +1,171 @@
+"""``heat3d lint`` — run the static-analysis checkers over the repo.
+
+Usage::
+
+    heat3d lint                          # all five checkers, human table
+    heat3d lint --json                   # machine verdict (CI gate)
+    heat3d lint --checker vmem-budget    # one checker (repeatable / CSV)
+    heat3d lint --write-baseline         # grandfather current findings
+    heat3d lint --list                   # checker catalog
+
+Severity policy (docs/ANALYSIS.md): rc 1 **only** on unsuppressed
+error-severity findings — warnings are drift that needs a decision, info
+is headroom context; neither reds a build. Suppression is two-layer:
+inline ``# heat3d-lint: ok=<checker>`` comments on the flagged line, and
+the repo-root baseline file (``.heat3d-lint-baseline.json``) holding
+line-number-free fingerprints of grandfathered findings. Regenerate the
+baseline with ``--write-baseline`` only after reviewing that every entry
+is genuinely grandfathered, not new.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+from typing import List, Optional
+
+from heat3d_tpu.analysis import CHECKERS
+from heat3d_tpu.analysis import astutil
+from heat3d_tpu.analysis.findings import (
+    BASELINE_NAME,
+    Finding,
+    apply_suppressions,
+    exit_code,
+    load_baseline,
+    render_json,
+    render_table,
+    write_baseline,
+)
+
+
+def run_checkers(root: str, names: List[str]) -> List[Finding]:
+    """All findings from the named checkers, in catalog order. A checker
+    that crashes is itself an error finding — a broken lint must never
+    read as a clean repo."""
+    astutil.clear_cache()
+    findings: List[Finding] = []
+    for name in names:
+        try:
+            mod = importlib.import_module(CHECKERS[name])
+            findings.extend(mod.check(root))
+        except Exception as e:  # noqa: BLE001 - surfaced as a finding
+            findings.append(
+                Finding(
+                    checker=name,
+                    severity="error",
+                    path="heat3d_tpu/analysis",
+                    line=0,
+                    code="ANL000",
+                    symbol=name,
+                    message=(
+                        f"checker crashed: {type(e).__name__}: {e} — fix "
+                        "the checker (a broken lint is a silent green)"
+                    ),
+                )
+            )
+    return findings
+
+
+def _resolve_checkers(raw: List[str]) -> List[str]:
+    if not raw:
+        return list(CHECKERS)
+    names: List[str] = []
+    for item in raw:
+        for name in item.split(","):
+            name = name.strip()
+            if name not in CHECKERS:
+                raise SystemExit(
+                    f"heat3d lint: unknown checker {name!r} "
+                    f"(known: {', '.join(CHECKERS)})"
+                )
+            if name not in names:
+                names.append(name)
+    return names
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="heat3d lint",
+        description="SPMD-safety and invariant lints over the repo "
+        "(docs/ANALYSIS.md). rc 1 only on unsuppressed error-severity "
+        "findings.",
+    )
+    p.add_argument("--json", action="store_true", help="machine verdict")
+    p.add_argument(
+        "--checker", action="append", default=[],
+        help="run only this checker (repeatable, or comma-separated)",
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="checkout root to lint (default: the root of the installed "
+        "source tree)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline suppressions file (default: <root>/{BASELINE_NAME})",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from the current unsuppressed "
+        "findings and exit 0 (review the diff before committing)",
+    )
+    p.add_argument(
+        "--no-suppress", action="store_true",
+        help="report everything, ignoring the baseline and inline "
+        "suppressions (audit view)",
+    )
+    p.add_argument(
+        "--list", action="store_true", help="print the checker catalog"
+    )
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name, modpath in CHECKERS.items():
+            doc = (importlib.import_module(modpath).__doc__ or "").strip()
+            print(f"{name}: {doc.splitlines()[0]}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else astutil.repo_root()
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    names = _resolve_checkers(args.checker)
+
+    findings = run_checkers(root, names)
+    baseline = load_baseline(baseline_path)
+    if args.no_suppress:
+        kept, suppressed = findings, []
+    else:
+        kept, suppressed = apply_suppressions(root, findings, baseline)
+
+    if args.write_baseline:
+        # Regenerate from the current findings with only INLINE
+        # suppressions applied — a still-firing grandfathered finding
+        # must stay in the baseline, not silently drop out and red the
+        # next run. Entries owned by checkers not run this invocation
+        # are carried over verbatim.
+        kept_inline, _ = apply_suppressions(root, findings, {})
+        # never grandfather a checker crash: its fingerprint is anchored
+        # on the checker name alone, so one baselined ANL000 would
+        # suppress EVERY future crash of that checker — the exact silent
+        # green the ANL000 tripwire exists to prevent
+        kept_inline = [f for f in kept_inline if f.code != "ANL000"]
+        carried = [
+            e for e in baseline.values() if e.get("checker") not in names
+        ]
+        n = write_baseline(baseline_path, kept_inline, carry=carried)
+        print(
+            f"heat3d lint: baseline written to {baseline_path} "
+            f"({n} suppression(s))"
+        )
+        return 0
+
+    if args.json:
+        render_json(kept, suppressed, names)
+    else:
+        render_table(kept, suppressed)
+    return exit_code(kept)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Checker: collective-divergence — the deadlock-by-construction hazard.
+
+The MPI ancestry of this codebase makes one defect class fatal in a way
+no unit test can catch: a collective (``ppermute``/``psum``/remote DMA)
+that *some* participants execute and others skip. On a pod that is not a
+failing test — it is a hung slice. The classic way to write one is a
+Python-level conditional around a collective whose truth value differs
+across participants at trace time:
+
+- **process-divergent** (``jax.process_index() == 0 and ...``): each host
+  traces its own program, so the guard compiles the collective into some
+  programs and not others — the TPU analog of an ``MPI_Isend`` with no
+  matching ``MPI_Irecv``.
+- **device-divergent** (``if lax.axis_index(..)``-derived values): a
+  traced per-device value in Python control flow — a trace-time error at
+  best, divergence if it ever concretizes.
+- **data-dependent** (``if float(jnp.max(u)) > t:``): host-materialized
+  array data steering whether a collective is traced; processes seeing
+  different shards take different branches.
+
+The checker flags collectives (and calls to *collective-bearing* repo
+functions — a call-graph fixpoint over the scanned files, so wrapping
+``ppermute`` in ``axis_ghosts`` in ``exchange_axis`` hides nothing)
+guarded by such conditionals. Uniform guards — static config flags,
+``periodic``, axis sizes, ``pl.when`` (traced, all devices evaluate it) —
+are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from heat3d_tpu.analysis import astutil
+from heat3d_tpu.analysis.findings import ERROR, Finding
+
+CHECKER = "collective-divergence"
+
+# jax collective primitives (dotted-name tails)
+COLLECTIVE_CALLS = {
+    "ppermute",
+    "psum",
+    "psum_scatter",
+    "pmean",
+    "pmax",
+    "pmin",
+    "pbroadcast",
+    "all_gather",
+    "all_to_all",
+    "make_async_remote_copy",
+}
+
+# host-level process identity: different VALUES on different hosts at
+# trace time -> divergent programs
+PROCESS_DIVERGENT_CALLS = {
+    "process_index",
+    "process_count",
+    "is_coordinator",
+    "host_id",
+    "gethostname",
+    "getpid",
+}
+
+# traced per-device identity: a Python branch on it is device-divergent
+DEVICE_DIVERGENT_CALLS = {"axis_index"}
+
+# host materialization of traced data: float()/int()/bool()/.item() over
+# a jnp/lax-derived value inside a conditional
+_MATERIALIZERS = {"float", "int", "bool"}
+_ARRAY_MODULES = ("jnp", "jax.numpy", "lax", "jax.lax")
+
+
+def _tail(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _collect_taint(func: ast.AST) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(process_tainted, device_tainted, data_tainted) local names in
+    ``func``: simple one-level flow from ``x = <divergent call>`` /
+    ``x = jnp.<op>(...)`` assignments."""
+    process: Set[str] = set()
+    device: Set[str] = set()
+    data: Set[str] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        tail = _tail(astutil.call_name(node.value))
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not targets or tail is None:
+            continue
+        dn = astutil.call_name(node.value) or ""
+        if tail in PROCESS_DIVERGENT_CALLS:
+            process.update(targets)
+        elif tail in DEVICE_DIVERGENT_CALLS:
+            device.update(targets)
+        elif any(dn.startswith(m + ".") for m in _ARRAY_MODULES):
+            data.update(targets)
+    return process, device, data
+
+
+def _classify_test(
+    test: ast.AST,
+    process_taint: Set[str],
+    device_taint: Set[str],
+    data_taint: Set[str],
+) -> Optional[Tuple[str, str, str]]:
+    """(kind, code, witness) when the conditional can diverge across
+    participants, else None."""
+    for call in astutil.calls_in(test):
+        tail = _tail(astutil.call_name(call))
+        if tail in PROCESS_DIVERGENT_CALLS:
+            return ("process-dependent", "ANL101", ast.unparse(test))
+        if tail in DEVICE_DIVERGENT_CALLS:
+            return ("device-dependent", "ANL102", ast.unparse(test))
+        if tail in _MATERIALIZERS or tail == "item":
+            inner = call.args[0] if call.args else call.func
+            inner_names = set(astutil.names_in(inner))
+            if tail == "item" or inner_names & data_taint or any(
+                (astutil.call_name(c) or "").startswith(m + ".")
+                for c in astutil.calls_in(inner)
+                for m in _ARRAY_MODULES
+            ):
+                return ("data-dependent", "ANL103", ast.unparse(test))
+    names = set(astutil.names_in(test))
+    if names & process_taint:
+        return ("process-dependent", "ANL101", ast.unparse(test))
+    if names & device_taint:
+        return ("device-dependent", "ANL102", ast.unparse(test))
+    if names & data_taint:
+        return ("data-dependent", "ANL103", ast.unparse(test))
+    return None
+
+
+def _collective_bearing_fixpoint(
+    trees: Dict[str, ast.Module]
+) -> Set[str]:
+    """Names of functions (across the scanned files) that transitively
+    contain a direct collective call — matched by simple name, which is
+    deliberately conservative for a lint."""
+    contains: Set[str] = set()
+    calls: Dict[str, Set[str]] = {}
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = node.name
+            callees = calls.setdefault(name, set())
+            for call in astutil.calls_in(node):
+                tail = _tail(astutil.call_name(call))
+                if tail in COLLECTIVE_CALLS:
+                    contains.add(name)
+                elif tail:
+                    callees.add(tail)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            if name not in contains and callees & contains:
+                contains.add(name)
+                changed = True
+    return contains
+
+
+def check(
+    root: str,
+    files: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    paths = list(
+        files
+        if files is not None
+        else astutil.iter_py_files(root, subdirs=("heat3d_tpu",))
+    )
+    trees: Dict[str, ast.Module] = {}
+    for p in paths:
+        t = astutil.parse_file(p)
+        if t is not None:
+            trees[p] = t
+    bearing = _collective_bearing_fixpoint(trees)
+
+    findings: List[Finding] = []
+    for path, tree in trees.items():
+        relpath = astutil.rel(root, path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _tail(astutil.call_name(node))
+            if tail in COLLECTIVE_CALLS:
+                direct = True
+            elif tail in bearing:
+                direct = False
+            else:
+                continue
+            func = astutil.enclosing_function(node)
+            if func is None:
+                continue
+            guards = astutil.guarding_conditionals(node)
+            if not guards:
+                continue
+            taints = _collect_taint(func)
+            for test, _stmt in guards:
+                verdict = _classify_test(test, *taints)
+                if verdict is None:
+                    continue
+                kind, code, witness = verdict
+                what = (
+                    f"collective '{astutil.call_name(node)}'"
+                    if direct
+                    else f"call to collective-bearing '{tail}'"
+                )
+                findings.append(
+                    Finding(
+                        checker=CHECKER,
+                        # all three divergence classes are deadlock
+                        # hazards — data-dependent (ANL103) included
+                        severity=ERROR,
+                        path=relpath,
+                        line=node.lineno,
+                        code=code,
+                        symbol=astutil.qualname(func),
+                        message=(
+                            f"{what} is guarded by a {kind} conditional "
+                            f"`{witness}` (line {test.lineno}): participants "
+                            "may disagree about executing the collective — "
+                            "a pod-deadlock hazard (conditionally-skipped "
+                            "collective). Hoist the collective out of the "
+                            "branch or make the guard uniform (static "
+                            "config / pl.when / jnp.where)."
+                        ),
+                    )
+                )
+                break  # one finding per collective site is enough
+    return findings

@@ -1,0 +1,140 @@
+"""Provenance lint for bench result records — the data-lint core behind
+``scripts/check_provenance.py`` (now a thin wrapper, the PR 3/4 promotion
+pattern), sharing the analysis finding/report format.
+
+A bench row must prove itself from the row alone: a ``ts`` naming its
+measurement session, the route-provenance fields saying which kernel path
+actually ran, ``sync_rtt_s`` making ``rtt_dominated`` auditable, and —
+on ``time_blocking > 1`` rows — ``cost_redundant_flops_frac`` carrying
+the deep-tb recompute tax. Rows that cannot prove those fail (rc 1).
+Sessions appending to a shared file scope the lint with ``--start-line``
+to the rows THEY wrote; a bare run over a whole legacy file still fails
+on legacy rows by design — the fix is re-landing the suite in a healthy
+window, not weakening the lint.
+
+The knob-drift checker cross-references :data:`ROUTE_FIELDS` against the
+bench harness, so a field required here but never recorded there is a
+static lint failure before any row is ever measured.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Tuple
+
+from heat3d_tpu.analysis.findings import ERROR, Finding, data_lint_main
+
+ROUTE_FIELDS = (
+    "platform",
+    "direct_path",
+    "mehrstellen_route",
+    "fused_dma_path",
+    "fused_dma_emulated",
+    "streamk_path",
+    "streamk_emulated",
+)
+MAX_REPORT = 20
+
+Defect = Tuple[int, str]
+
+
+def check_row(r: dict) -> list:
+    problems = []
+    ts = r.get("ts")
+    if not (isinstance(ts, str) and ts):
+        problems.append(
+            "ts missing/null (row cannot prove its measurement session)"
+        )
+    if r.get("bench") == "throughput":
+        for f in ROUTE_FIELDS:
+            if f not in r:
+                problems.append(f"missing route-provenance field {f!r}")
+        if "chain_ops" not in r:
+            problems.append("missing route-provenance field 'chain_ops'")
+        elif r["chain_ops"] is None and r.get("backend") != "conv":
+            problems.append(
+                "chain_ops is null on a non-conv row (op-count provenance "
+                "lost)"
+            )
+        # temporally-blocked rows execute redundant ghost-ring recompute;
+        # without the recorded fraction their Gcell/s cannot be discounted
+        # to useful work at judging time (deep-tb honesty — a tb=4 "win"
+        # must carry its own recompute tax on the row)
+        tb = r.get("time_blocking", 1)
+        if isinstance(tb, int) and tb > 1 and not isinstance(
+            r.get("cost_redundant_flops_frac"), (int, float)
+        ):
+            problems.append(
+                "cost_redundant_flops_frac missing/non-numeric on a "
+                f"time_blocking={tb} row (redundant-compute provenance "
+                "lost)"
+            )
+    elif r.get("bench") == "halo":
+        if "platform" not in r:
+            problems.append("missing 'platform'")
+    if r.get("bench") in ("throughput", "halo") and not isinstance(
+        r.get("sync_rtt_s"), (int, float)
+    ):
+        problems.append(
+            "sync_rtt_s missing/non-numeric (RTT-dominated samples not "
+            "auditable from the row)"
+        )
+    return problems
+
+
+def check_file(path: str, start_line: int = 1) -> list:
+    """(line_no, description) for every defect in ``path`` at or after
+    ``start_line`` (1-based; earlier lines belong to a prior session)."""
+    bad = []
+    try:
+        f = open(path)
+    except OSError as e:
+        return [(0, f"cannot open {path}: {e}")]
+    with f:
+        for i, line in enumerate(f, start=1):
+            if i < start_line:
+                continue
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                bad.append((i, "unparseable JSON"))
+                continue
+            if not isinstance(r, dict) or r.get("bench") not in (
+                "throughput",
+                "halo",
+            ):
+                continue  # foreign lines (headline records, notes) pass
+            for p in check_row(r):
+                bad.append((i, p))
+    return bad
+
+
+def check_file_findings(path: str, start_line: int = 1) -> List[Finding]:
+    """The same defects as :func:`check_file`, in the shared analysis
+    finding format (data lints are error-severity by definition: a row
+    that cannot prove its provenance is already lost)."""
+    return [
+        Finding(
+            checker="provenance",
+            severity=ERROR,
+            path=path,
+            line=line_no,
+            code="DATA-PROV",
+            message=desc,
+        )
+        for line_no, desc in check_file(path, start_line)
+    ]
+
+
+def main(argv=None) -> int:
+    return data_lint_main(
+        argv, "provenance", check_file, __doc__, max_report=MAX_REPORT
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""Canonical registries the checkers (and the data lints) consume.
+
+Three parallel vocabularies used to drift silently as PRs landed: ledger
+event names (emitted in code, narrated in docs/OBSERVABILITY.md, pattern-
+matched by ``obs summary``), ``HEAT3D_*`` environment knobs (read all
+over, documented sporadically), and the config-knob surface (checked by
+:mod:`heat3d_tpu.analysis.knobs` against live sources, not a registry).
+This module is the single source of truth for the first two:
+
+- :data:`LEDGER_EVENTS` — every event/span name the framework may emit.
+  The taxonomy checker fails the lint when code emits an unregistered
+  name (or the registered kind disagrees), and when a registered name is
+  missing from the docs/OBSERVABILITY.md taxonomy table. The ledger data
+  lint (``heat3d obs check --taxonomy`` / ``scripts/check_ledger.py
+  --taxonomy``) flags unknown names in actual ledger files against the
+  same registry.
+- :data:`ENV_VARS` — every ``HEAT3D_*`` knob the framework reads.
+  Same enforcement: referenced-but-unregistered fails, registered-but-
+  undocumented fails, registered-but-unreferenced warns (stale entry).
+
+Adding an event or env knob is a three-line change by design: emit it,
+register it here, add its row to the docs/OBSERVABILITY.md taxonomy
+table — and the lint holds the three together from then on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+# ---- ledger-event taxonomy -------------------------------------------------
+# name -> {kind: point|span, module: emitter, desc, external: emitted by
+# generated/child code the AST scan cannot see (registry + docs only)}
+
+LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
+    # lifecycle (obs/ledger.py writes the open/close frames itself)
+    "ledger_open": {"kind": "point", "module": "obs/ledger.py",
+                    "desc": "stream header: schema, pid, argv, meta"},
+    "ledger_close": {"kind": "point", "module": "obs/ledger.py",
+                     "desc": "stream footer: rc, error if any"},
+    "run_start": {"kind": "point", "module": "cli.py",
+                  "desc": "resolved config of the run about to execute"},
+    "run_summary": {"kind": "point", "module": "cli.py",
+                    "desc": "machine mirror of the stdout JSON summary"},
+    "metrics_summary": {"kind": "point", "module": "cli.py, bench/__main__.py",
+                        "desc": "final metrics-registry snapshot"},
+    "residual": {"kind": "point", "module": "cli.py",
+                 "desc": "mid-run L2 residual at a reporting boundary"},
+    # stepping
+    "warmup": {"kind": "span", "module": "cli.py",
+               "desc": "executable warmup outside the timed window"},
+    "run_loop": {"kind": "span", "module": "cli.py",
+                 "desc": "the whole timed stepping loop (steps field)"},
+    "chunk": {"kind": "span", "module": "resilience/supervisor.py",
+              "desc": "one supervised checkpoint window (force-synced)"},
+    "init_state": {"kind": "span", "module": "models/heat3d.py",
+                   "desc": "sharded initial-state construction"},
+    # resilience
+    "supervised_start": {"kind": "point", "module": "resilience/supervisor.py",
+                         "desc": "supervisor engaged: target step, cadence"},
+    "supervised_end": {"kind": "point", "module": "resilience/supervisor.py",
+                       "desc": "supervisor done: steps, recoveries"},
+    "fault_injected": {"kind": "point", "module": "resilience/faults.py",
+                       "desc": "deterministic fault fired (kind_ field)"},
+    "retry_attempt": {"kind": "point", "module": "resilience/retry.py",
+                      "desc": "one RetryPolicy attempt (ok, delay)"},
+    "retry_outcome": {"kind": "point", "module": "resilience/retry.py",
+                      "desc": "RetryPolicy.run verdict (stop_reason)"},
+    "heal_wait": {"kind": "span", "module": "resilience/supervisor.py",
+                  "desc": "backend heal wait (priced outage)"},
+    "recovery": {"kind": "point", "module": "resilience/supervisor.py",
+                 "desc": "survived failure: kind_, resumed_from"},
+    "generation_save": {"kind": "point", "module": "resilience/supervisor.py",
+                        "desc": "checkpoint generation written"},
+    "backend_probe": {"kind": "span", "module": "utils/backendprobe.py",
+                      "desc": "out-of-process backend liveness probe"},
+    # checkpoints
+    "ckpt_save": {"kind": "span", "module": "utils/checkpoint.py",
+                  "desc": "checkpoint write (path, step)"},
+    "ckpt_load": {"kind": "span", "module": "utils/checkpoint.py",
+                  "desc": "checkpoint read (path)"},
+    "ckpt_corrupt": {"kind": "point", "module": "utils/checkpoint.py",
+                     "desc": "shard checksum mismatch detected"},
+    "ckpt_quarantine": {"kind": "point", "module": "utils/checkpoint.py",
+                        "desc": "corrupt generation renamed aside"},
+    # bench
+    "bench_row": {"kind": "point", "module": "bench/harness.py",
+                  "desc": "full measured row mirrored into the ledger (ts_)"},
+    "bench_row_measure": {"kind": "span", "module": "bench/harness.py",
+                          "desc": "one row's measurement bracket"},
+    "bench_row_replayed": {"kind": "point", "module": "bench/harness.py",
+                           "desc": "row re-emitted from a sweep journal"},
+    "bench_row_pending": {"kind": "point", "module": "bench/harness.py",
+                          "desc": "row measured off-platform, deferred"},
+    "probe_skipped": {"kind": "point", "module": "bench.py (child code)",
+                      "external": True,
+                      "desc": "bench probe ladder skipped (fast path)"},
+    # perf observability
+    "profile_capture": {"kind": "point", "module": "obs/perf/profiling.py",
+                        "desc": "profiler bracket: artifact, overhead, ok"},
+    "step_cost": {"kind": "point", "module": "obs/perf/roofline.py",
+                  "desc": "XLA cost_analysis of the step executable"},
+    "peak_calibrated": {"kind": "point", "module": "obs/perf/roofline.py",
+                        "desc": "measured per-chip VPU peak stored"},
+    # autotuning
+    "tune_search_start": {"kind": "point", "module": "tune/measure.py",
+                          "desc": "search opened: space, budget, key"},
+    "tune_trial": {"kind": "point", "module": "tune/measure.py",
+                   "desc": "one candidate: measured/pruned/dominated/error"},
+    "tune_winner": {"kind": "point", "module": "tune/measure.py",
+                    "desc": "search verdict: winning knobs + metric"},
+    "tune_budget_exhausted": {"kind": "point", "module": "tune/measure.py",
+                              "desc": "unmeasured candidates at budget end"},
+    "tune_probe": {"kind": "span", "module": "tune/measure.py",
+                   "desc": "short-probe bracket (early stopping)"},
+    "tune_trial_measure": {"kind": "span", "module": "tune/measure.py",
+                           "desc": "full trial measurement bracket"},
+    "tune_cache_hit": {"kind": "point", "module": "tune/cache.py",
+                       "desc": "auto knobs resolved from a cache entry"},
+    "tune_cache_miss": {"kind": "point", "module": "tune/cache.py",
+                        "desc": "no entry for this context (static fallback)"},
+    "tune_cache_stale": {"kind": "point", "module": "tune/cache.py",
+                         "desc": "entry rejected: jax/schema/env mismatch"},
+}
+
+# Wrapper functions whose first argument is an event name (the taxonomy
+# checker treats `_event_once("tune_cache_miss", ...)` like
+# `.event("tune_cache_miss", ...)`); `_write` carries (name, kind).
+EVENT_WRAPPERS = ("_event_once",)
+
+
+# ---- HEAT3D_* environment-knob registry ------------------------------------
+# name -> {module: primary reader, desc}. The taxonomy checker scans
+# heat3d_tpu/, bench.py and scripts/ for HEAT3D_* tokens and fails on any
+# not registered here; docs/OBSERVABILITY.md must carry every row.
+
+ENV_VARS: Dict[str, Dict[str, str]] = {
+    "HEAT3D_LEDGER": {"module": "obs/ledger.py",
+                      "desc": "run-ledger path (--ledger fallback)"},
+    "HEAT3D_METRICS": {"module": "obs/metrics.py",
+                       "desc": "metrics snapshot path (.prom = textfile)"},
+    "HEAT3D_COST_ANALYSIS": {"module": "obs/perf/roofline.py",
+                             "desc": "0 skips the step-cost compile"},
+    "HEAT3D_PEAK_MEM_GBPS": {"module": "obs/perf/roofline.py",
+                             "desc": "HBM peak override for roofline"},
+    "HEAT3D_PEAK_GFLOPS": {"module": "obs/perf/roofline.py",
+                           "desc": "VPU peak override for roofline"},
+    "HEAT3D_CKPT_VERIFY": {"module": "utils/checkpoint.py",
+                           "desc": "0 skips shard CRC verification"},
+    "HEAT3D_PROBE_TIMEOUT": {"module": "utils/backendprobe.py",
+                             "desc": "per-probe budget seconds (default 60)"},
+    "HEAT3D_COORDINATOR": {"module": "parallel/distributed.py",
+                           "desc": "multihost coordinator addr:port"},
+    "HEAT3D_NUM_PROCESSES": {"module": "parallel/distributed.py",
+                             "desc": "multihost process count"},
+    "HEAT3D_PROCESS_ID": {"module": "parallel/distributed.py",
+                          "desc": "this host's process index"},
+    "HEAT3D_AUTO_DISTRIBUTED": {"module": "parallel/distributed.py",
+                                "desc": "1 = initialize() autodetect"},
+    "HEAT3D_DEVICE_INIT": {"module": "models/heat3d.py",
+                           "desc": "0 forces host-side state init"},
+    "HEAT3D_FACTOR_7PT": {"module": "core/stencils.py",
+                          "desc": "0 disables 7pt x-reflection factoring"},
+    "HEAT3D_FACTOR_Y": {"module": "core/stencils.py",
+                        "desc": "0 disables y-reflection factoring"},
+    "HEAT3D_MEHRSTELLEN": {"module": "core/stencils.py",
+                           "desc": "27pt separable-decomposition route"},
+    "HEAT3D_NO_DIRECT": {"module": "parallel/step.py, ops/stencil_pallas.py",
+                         "desc": "1 disables the direct kernel routes"},
+    "HEAT3D_DIRECT_INTERPRET": {"module": "parallel/step.py",
+                                "desc": "1 routes kernels through the Pallas interpreter off-TPU (tests)"},
+    "HEAT3D_DIRECT_FORCE": {"module": "parallel/step.py",
+                            "desc": "1 selects real Mosaic kernels off-TPU (compile-only tests)"},
+    "HEAT3D_VMEM_BYTES": {"module": "ops/stencil_dma_fused.py",
+                          "desc": "whole-chip VMEM ceiling for the fused-DMA gate (default 32 MiB)"},
+    "HEAT3D_FAULTS": {"module": "resilience/faults.py",
+                      "desc": "deterministic fault-injection plan"},
+    "HEAT3D_FAULT_STATE": {"module": "resilience/faults.py",
+                           "desc": "fault-injection state file (fire-once)"},
+    "HEAT3D_TUNE_CACHE": {"module": "tune/cache.py",
+                          "desc": "tuning-cache store path"},
+    "HEAT3D_TUNE_DISABLE": {"module": "tune/cache.py",
+                            "desc": "1 skips cache lookup (search driver sets it)"},
+    "HEAT3D_BENCH_GRID": {"module": "bench.py",
+                          "desc": "headline-bench grid edge override"},
+    "HEAT3D_BENCH_CPU_GRID": {"module": "bench.py",
+                              "desc": "grid edge for the CPU-fallback arm"},
+    "HEAT3D_BENCH_STEPS": {"module": "bench.py",
+                           "desc": "headline-bench step count"},
+    "HEAT3D_BENCH_DTYPE": {"module": "bench.py",
+                           "desc": "headline-bench dtype (fp32|bf16)"},
+    "HEAT3D_BENCH_BACKEND": {"module": "bench.py",
+                             "desc": "headline-bench backend override"},
+    "HEAT3D_BENCH_TIME_BLOCKING": {"module": "bench.py",
+                                   "desc": "headline-bench tb override"},
+    "HEAT3D_BENCH_DEADLINE": {"module": "bench.py",
+                              "desc": "wall-clock budget for the whole bench"},
+    "HEAT3D_BENCH_RUNG_TIMEOUT": {"module": "bench.py",
+                                  "desc": "per-rung child timeout seconds"},
+    "HEAT3D_BENCH_PROBE_ATTEMPTS": {"module": "bench.py",
+                                    "desc": "backend probe ladder length"},
+    "HEAT3D_BENCH_PROBE_BACKOFF": {"module": "bench.py",
+                                   "desc": "probe ladder backoff factor"},
+    "HEAT3D_BENCH_CHILD": {"module": "bench.py",
+                           "desc": "internal: marks the killable child"},
+    "HEAT3D_BENCH_ARGS": {"module": "scripts/tpu_measure_all.sh",
+                          "desc": "extra flags threaded into bench rows"},
+}
+
+
+# ---- fail-soft contract ----------------------------------------------------
+# The telemetry functions production code calls unconditionally; the
+# documented invariant (docs/OBSERVABILITY.md "Failure posture") is that
+# none of them can propagate an environmental failure (IO, serialization)
+# to the instrumented run. Module path -> qualnames under contract.
+
+FAIL_SOFT_CONTRACT: Dict[str, tuple] = {
+    "heat3d_tpu/obs/ledger.py": (
+        "activate",
+        "get",
+        "deactivate",
+        "Ledger.event",
+        "Ledger.span",
+        "Ledger.set_context",
+        "Ledger.close",
+        "NullLedger.event",
+        "NullLedger.span",
+    ),
+    "heat3d_tpu/obs/metrics.py": (
+        "export_at_exit",
+    ),
+    "heat3d_tpu/obs/trace.py": (
+        "named_phase",
+        "annotate",
+    ),
+    "heat3d_tpu/obs/perf/profiling.py": (
+        "profile_capture",
+        "_ProfileCapture.__enter__",
+        "_ProfileCapture.__exit__",
+    ),
+}
+
+# Modules whose functions participate in fail-soft call-graph resolution
+# (the contract functions may call helpers here; risk propagates through).
+FAIL_SOFT_MODULES = tuple(FAIL_SOFT_CONTRACT)

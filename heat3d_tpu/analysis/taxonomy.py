@@ -1,0 +1,301 @@
+"""Checker: ledger-event taxonomy + ``HEAT3D_*`` env-knob registry drift.
+
+The ledger's event vocabulary and the env-knob surface are contracts
+consumed far from where they are produced: ``obs summary`` pattern-
+matches event names, operators grep docs/OBSERVABILITY.md for knobs,
+``check_ledger`` audits streams. Five PRs in, both had drifted — spans
+emitted nowhere in the docs (``init_state``, ``tune_probe``), env knobs
+documented nowhere at all (``HEAT3D_PROBE_TIMEOUT`` and the whole
+``HEAT3D_BENCH_*`` family). This checker pins all three legs together
+through :mod:`heat3d_tpu.analysis.registry`:
+
+- every ``.event("name")`` / ``.span("name")`` literal (plus registered
+  wrapper calls like ``_event_once`` and the ledger's internal
+  ``_write(name, kind)``) must name a registered event, with the
+  registered *kind* (point vs span) matching the emission form;
+- every registered event must appear in docs/OBSERVABILITY.md (the
+  taxonomy table) — and registry entries nothing emits anymore are
+  flagged stale (``external`` entries, emitted by generated child code
+  the AST cannot see, are exempt from the emission check only);
+- every ``HEAT3D_*`` token referenced in ``heat3d_tpu/``, ``bench.py``
+  or ``scripts/`` must be a registered env var, every registered var
+  must be documented, and registered-but-unreferenced vars are stale.
+  Prefix references (``HEAT3D_BENCH_*`` in prose) match any registered
+  var that extends them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from heat3d_tpu.analysis import astutil
+from heat3d_tpu.analysis.findings import ERROR, WARNING, Finding
+from heat3d_tpu.analysis.registry import ENV_VARS, EVENT_WRAPPERS, LEDGER_EVENTS
+
+CHECKER = "ledger-taxonomy"
+
+_ENV_TOKEN = re.compile(r"HEAT3D_[A-Z0-9_]+")
+_DOCS = "docs/OBSERVABILITY.md"
+
+
+def _emissions(
+    root: str, files: Sequence[str]
+) -> List[Tuple[str, str, str, int]]:
+    """(name, kind, relpath, line) for every literal event/span emission."""
+    out: List[Tuple[str, str, str, int]] = []
+    for path in files:
+        tree = astutil.parse_file(path)
+        if tree is None:
+            continue
+        relpath = astutil.rel(root, path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            m = astutil.method_name(node)
+            if m in ("event", "span"):
+                name = astutil.literal_str_arg(node, 0)
+                if name is not None:
+                    kind = "point" if m == "event" else "span"
+                    out.append((name, kind, relpath, node.lineno))
+            elif m in EVENT_WRAPPERS:
+                name = astutil.literal_str_arg(node, 0)
+                if name is not None:
+                    out.append((name, "point", relpath, node.lineno))
+            elif m == "_write":
+                name = astutil.literal_str_arg(node, 0)
+                kind = astutil.literal_str_arg(node, 1)
+                if name is not None and kind in ("point", "span"):
+                    out.append((name, kind, relpath, node.lineno))
+    return out
+
+
+def _env_tokens(
+    root: str, files: Sequence[str]
+) -> Dict[str, Tuple[str, int]]:
+    """token -> first (relpath, line) reference, from code + scripts."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for path in files:
+        relpath = astutil.rel(root, path)
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, start=1):
+            for m in _ENV_TOKEN.finditer(line):
+                tok = m.group(0).rstrip("_")
+                if tok == "HEAT3D" or tok in out:
+                    continue
+                out[tok] = (relpath, i)
+    return out
+
+
+def _docs_has_row(
+    docs_text: str, name: str, kind: Optional[str] = None
+) -> bool:
+    """True when the docs carry a rendered taxonomy-table row for
+    ``name`` (``| `name` | kind | ...``). Anchored to the backticked
+    row start — a bare substring test would let `bench_row` ride on
+    `bench_row_measure`'s row after its own was deleted — and, for
+    events, the row's kind column must match the registry."""
+    for line in docs_text.splitlines():
+        if line.startswith(f"| `{name}` |"):
+            if kind is None or f"| {kind} |" in line:
+                return True
+    return False
+
+
+def check(
+    root: str,
+    files: Optional[Sequence[str]] = None,
+    events_registry: Optional[Dict[str, Dict]] = None,
+    env_registry: Optional[Dict[str, Dict]] = None,
+    docs_path: str = _DOCS,
+) -> List[Finding]:
+    events_registry = (
+        events_registry if events_registry is not None else LEDGER_EVENTS
+    )
+    env_registry = env_registry if env_registry is not None else ENV_VARS
+
+    if files is None:
+        code_files = [
+            p
+            for p in astutil.iter_py_files(
+                root, subdirs=("heat3d_tpu",), extras=("bench.py",)
+            )
+            # the analysis package and registry NAME events/vars without
+            # emitting them; scanning them would count every registry
+            # entry as emitted
+            if os.sep + "analysis" + os.sep not in p
+        ]
+        script_files = [
+            os.path.join(root, "scripts", fn)
+            for fn in sorted(os.listdir(os.path.join(root, "scripts")))
+            if fn.endswith((".sh", ".py"))
+        ] if os.path.isdir(os.path.join(root, "scripts")) else []
+    else:
+        code_files = list(files)
+        script_files = []
+
+    findings: List[Finding] = []
+
+    # ---- ledger events -----------------------------------------------------
+    emitted: Dict[str, List[Tuple[str, str, int]]] = {}
+    for name, kind, relpath, line in _emissions(root, code_files):
+        emitted.setdefault(name, []).append((kind, relpath, line))
+        reg = events_registry.get(name)
+        if reg is None:
+            findings.append(
+                Finding(
+                    checker=CHECKER,
+                    severity=ERROR,
+                    path=relpath,
+                    line=line,
+                    code="ANL401",
+                    symbol=name,
+                    message=(
+                        f"ledger event '{name}' is emitted but not in the "
+                        "canonical registry "
+                        "(heat3d_tpu/analysis/registry.LEDGER_EVENTS) — "
+                        "register it and add its docs/OBSERVABILITY.md "
+                        "taxonomy row"
+                    ),
+                )
+            )
+        elif reg.get("kind") != kind:
+            findings.append(
+                Finding(
+                    checker=CHECKER,
+                    severity=ERROR,
+                    path=relpath,
+                    line=line,
+                    code="ANL402",
+                    symbol=name,
+                    message=(
+                        f"ledger event '{name}' emitted as {kind} but "
+                        f"registered as {reg.get('kind')} — obs summary's "
+                        "span tables and the data lint key on the kind"
+                    ),
+                )
+            )
+
+    docs_file = os.path.join(root, docs_path)
+    try:
+        with open(docs_file) as f:
+            docs_text = f.read()
+    except OSError as e:
+        # an unreadable docs file must not silently disable the whole
+        # documentation leg (ANL404/412) — that's a finding, not a skip
+        docs_text = None
+        findings.append(
+            Finding(
+                checker=CHECKER,
+                severity=ERROR,
+                path=docs_path,
+                line=0,
+                code="ANL405",
+                message=(
+                    f"taxonomy docs file unreadable ({e}) — the "
+                    "registered-must-be-documented checks cannot run"
+                ),
+            )
+        )
+
+    for name, reg in sorted(events_registry.items()):
+        if name not in emitted and not reg.get("external"):
+            findings.append(
+                Finding(
+                    checker=CHECKER,
+                    severity=WARNING,
+                    path="heat3d_tpu/analysis/registry.py",
+                    line=0,
+                    code="ANL403",
+                    symbol=name,
+                    message=(
+                        f"registered ledger event '{name}' is never "
+                        "emitted — stale registry entry (or the emitter "
+                        "moved behind a dynamic name; mark it external)"
+                    ),
+                )
+            )
+        if docs_text is not None and not _docs_has_row(
+            docs_text, name, reg.get("kind")
+        ):
+            findings.append(
+                Finding(
+                    checker=CHECKER,
+                    severity=ERROR,
+                    path=docs_path,
+                    line=0,
+                    code="ANL404",
+                    symbol=name,
+                    message=(
+                        f"registered ledger event '{name}' has no "
+                        f"taxonomy-table row in {docs_path} with its "
+                        f"registered kind ({reg.get('kind')}) — add/fix "
+                        "the row"
+                    ),
+                )
+            )
+
+    # ---- env vars ----------------------------------------------------------
+    referenced = _env_tokens(root, list(code_files) + script_files)
+
+    def _covers(tok: str) -> bool:
+        return tok in env_registry or any(
+            v.startswith(tok + "_") for v in env_registry
+        )
+
+    for tok, (relpath, line) in sorted(referenced.items()):
+        if not _covers(tok):
+            findings.append(
+                Finding(
+                    checker=CHECKER,
+                    severity=ERROR,
+                    path=relpath,
+                    line=line,
+                    code="ANL411",
+                    symbol=tok,
+                    message=(
+                        f"env knob '{tok}' is referenced but not in the "
+                        "canonical registry "
+                        "(heat3d_tpu/analysis/registry.ENV_VARS) — register "
+                        "it and add its docs/OBSERVABILITY.md taxonomy row"
+                    ),
+                )
+            )
+    for var in sorted(env_registry):
+        if docs_text is not None and not _docs_has_row(docs_text, var):
+            findings.append(
+                Finding(
+                    checker=CHECKER,
+                    severity=ERROR,
+                    path=docs_path,
+                    line=0,
+                    code="ANL412",
+                    symbol=var,
+                    message=(
+                        f"registered env knob '{var}' has no "
+                        f"taxonomy-table row in {docs_path} — add it"
+                    ),
+                )
+            )
+        if var not in referenced:
+            findings.append(
+                Finding(
+                    checker=CHECKER,
+                    severity=WARNING,
+                    path="heat3d_tpu/analysis/registry.py",
+                    line=0,
+                    code="ANL413",
+                    symbol=var,
+                    message=(
+                        f"registered env knob '{var}' is referenced "
+                        "nowhere in code or scripts — stale registry entry"
+                    ),
+                )
+            )
+    return findings

@@ -219,6 +219,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from heat3d_tpu.tune.cli import main as tune_main
 
         return tune_main(argv_l[1:])
+    # `heat3d lint ...` — the static-analysis surface (docs/ANALYSIS.md):
+    # SPMD-safety + invariant checkers, rc 1 only on error severity
+    if argv_l and argv_l[0] == "lint":
+        from heat3d_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv_l[1:])
     # A measurement script stopping this run with `timeout` (SIGTERM) must
     # release the axon pool's chip claim on the way out, not die holding it.
     from heat3d_tpu.utils.backendprobe import install_sigterm_exit

@@ -1,209 +1,19 @@
-"""Ledger schema lint: required fields, monotonic span nesting, run-id
-consistency. ``scripts/check_ledger.py`` and ``heat3d obs check`` are both
-thin wrappers over :func:`check_file`, so the CI gate and the operator
-command cannot drift apart.
-
-Rules (per defect a ``(line, description)`` pair):
-
-- every line parses as a JSON object;
-- required fields (:data:`~heat3d_tpu.obs.ledger.REQUIRED_FIELDS`) are
-  present and well-typed; ``kind`` is ``point`` or ``span``;
-- span events carry ``t0``/``t1``/``dur_s``/``depth``/``status`` with
-  ``t1 >= t0`` and ``dur_s`` consistent;
-- per ``(run_id, proc)``: ``seq`` strictly increases (an append-only
-  stream cannot reorder), exactly one ``ledger_open`` exists and is that
-  stream's first event, and spans form a proper nesting — each pair of
-  spans is disjoint or contained, never partially overlapping (checked on
-  the monotonic ``t0``/``t1`` bounds, so wall-clock steps can't fake a
-  violation).
+"""Ledger schema lint — compatibility shim. The implementation was
+promoted into :mod:`heat3d_tpu.analysis.ledgerlint` (the analysis
+subsystem owns the data-lint cores and their shared finding format);
+``scripts/check_ledger.py`` and ``heat3d obs check`` keep importing from
+here, so the CI gate and the operator command still cannot drift apart.
 """
 
 from __future__ import annotations
 
-import json
-import sys
-from collections import defaultdict
-from typing import Any, Dict, List, Tuple
-
-from heat3d_tpu.obs.ledger import REQUIRED_FIELDS, SPAN_FIELDS
-
-# tolerance for float comparisons on span bounds: spans written at close
-# under one lock are strictly ordered, but dur_s is stored rounded-ish
-# (full float, really) — keep a small epsilon anyway
-EPS = 1e-6
-MAX_REPORT = 20
-
-Defect = Tuple[int, str]
-
-
-def _check_event(rec: Dict[str, Any]) -> List[str]:
-    problems = []
-    for f in REQUIRED_FIELDS:
-        if f not in rec:
-            problems.append(f"missing required field {f!r}")
-    if "ts" in rec and not isinstance(rec["ts"], (int, float)):
-        problems.append("ts is not a number")
-    if "run_id" in rec and not (
-        isinstance(rec["run_id"], str) and rec["run_id"]
-    ):
-        problems.append("run_id is not a non-empty string")
-    if "proc" in rec and not isinstance(rec["proc"], int):
-        problems.append("proc is not an int")
-    if "seq" in rec and not isinstance(rec["seq"], int):
-        problems.append("seq is not an int")
-    kind = rec.get("kind")
-    if "kind" in rec and kind not in ("point", "span"):
-        problems.append(f"kind {kind!r} is not 'point' or 'span'")
-    if kind == "span":
-        for f in SPAN_FIELDS:
-            if f not in rec:
-                problems.append(f"span missing field {f!r}")
-        t0, t1, dur = rec.get("t0"), rec.get("t1"), rec.get("dur_s")
-        if all(isinstance(v, (int, float)) for v in (t0, t1, dur)):
-            if t1 < t0 - EPS:
-                problems.append(f"span ends before it starts (t0={t0}, t1={t1})")
-            if abs((t1 - t0) - dur) > 1e-3:
-                problems.append(
-                    f"dur_s {dur} disagrees with t1-t0 {t1 - t0}"
-                )
-        if rec.get("status") not in ("ok", "error", None):
-            problems.append(f"span status {rec.get('status')!r} invalid")
-    return problems
-
-
-def _check_nesting(
-    spans: List[Tuple[int, float, float]]
-) -> List[Defect]:
-    """Spans (line, t0, t1) of one (run_id, proc) stream must form a
-    laminar family: any two are disjoint or one contains the other. Sorted
-    by (t0 asc, t1 desc), a stack scan finds every partial overlap."""
-    bad: List[Defect] = []
-    stack: List[Tuple[int, float, float]] = []
-    for line, t0, t1 in sorted(spans, key=lambda s: (s[1], -s[2])):
-        while stack and stack[-1][2] <= t0 + EPS:
-            stack.pop()
-        if stack and t1 > stack[-1][2] + EPS:
-            bad.append(
-                (
-                    line,
-                    f"span [{t0:.6f}, {t1:.6f}] partially overlaps span "
-                    f"at line {stack[-1][0]} "
-                    f"[{stack[-1][1]:.6f}, {stack[-1][2]:.6f}] — "
-                    "not properly nested",
-                )
-            )
-            continue
-        stack.append((line, t0, t1))
-    return bad
-
-
-def check_file(path: str, start_line: int = 1) -> List[Defect]:
-    """Every defect in the ledger at ``path`` as (line, description),
-    line-ordered.
-
-    ``start_line`` scopes the REPORT to defects at/after that line (the
-    whole file is still parsed for stream context — seq chains and span
-    nesting cross the boundary): APPEND-mode suite sessions lint only the
-    segments THEY wrote, the same rule check_provenance.py applies to
-    bench rows, so one historical defect cannot keep every resumed
-    session permanently red."""
-    bad: List[Defect] = []
-    streams: Dict[Tuple[str, int], List[Tuple[int, Dict[str, Any]]]] = (
-        defaultdict(list)
-    )
-    try:
-        f = open(path)
-    except OSError as e:
-        return [(0, f"cannot open {path}: {e}")]
-    with f:
-        for i, line in enumerate(f, start=1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                bad.append((i, "unparseable JSON"))
-                continue
-            if not isinstance(rec, dict):
-                bad.append((i, "event is not a JSON object"))
-                continue
-            for p in _check_event(rec):
-                bad.append((i, p))
-            if isinstance(rec.get("run_id"), str) and isinstance(
-                rec.get("proc"), int
-            ):
-                streams[(rec["run_id"], rec["proc"])].append((i, rec))
-
-    for (run_id, proc), events in sorted(streams.items()):
-        label = f"run {run_id} proc {proc}"
-        opens = [i for i, r in events if r.get("event") == "ledger_open"]
-        if not opens:
-            bad.append(
-                (events[0][0], f"{label}: no ledger_open event (orphan run-id)")
-            )
-        elif len(opens) > 1:
-            bad.append(
-                (opens[1], f"{label}: duplicate ledger_open at line {opens[1]}")
-            )
-        elif opens[0] != events[0][0]:
-            bad.append(
-                (
-                    opens[0],
-                    f"{label}: ledger_open is not the stream's first event",
-                )
-            )
-        prev_seq = None
-        prev_line = None
-        for i, r in events:
-            seq = r.get("seq")
-            if not isinstance(seq, int):
-                continue
-            if prev_seq is not None and seq <= prev_seq:
-                bad.append(
-                    (
-                        i,
-                        f"{label}: seq {seq} not above seq {prev_seq} at "
-                        f"line {prev_line} (stream reordered or truncated "
-                        "mid-write)",
-                    )
-                )
-            prev_seq, prev_line = seq, i
-        spans = [
-            (i, float(r["t0"]), float(r["t1"]))
-            for i, r in events
-            if r.get("kind") == "span"
-            and isinstance(r.get("t0"), (int, float))
-            and isinstance(r.get("t1"), (int, float))
-        ]
-        bad.extend(
-            (i, f"{label}: {msg}") for i, msg in _check_nesting(spans)
-        )
-    return sorted(d for d in bad if d[0] >= start_line)
-
-
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    start_line = 1
-    if argv and argv[0] == "--start-line":
-        if len(argv) < 2:
-            print("--start-line needs a value", file=sys.stderr)
-            return 2
-        start_line = int(argv[1])
-        argv = argv[2:]
-    if not argv:
-        print(__doc__, file=sys.stderr)
-        return 2
-    failed = False
-    for path in argv:
-        bad = check_file(path, start_line)
-        if not bad:
-            print(f"ledger ok: {path}")
-            continue
-        failed = True
-        print(f"ledger FAIL: {path}: {len(bad)} defect(s)", file=sys.stderr)
-        for line_no, desc in bad[:MAX_REPORT]:
-            print(f"  {path}:{line_no}: {desc}", file=sys.stderr)
-        if len(bad) > MAX_REPORT:
-            print(f"  ... and {len(bad) - MAX_REPORT} more", file=sys.stderr)
-    return 1 if failed else 0
+from heat3d_tpu.analysis.ledgerlint import (  # noqa: F401
+    EPS,
+    MAX_REPORT,
+    Defect,
+    _check_event,
+    _check_nesting,
+    check_file,
+    check_file_findings,
+    main,
+)

@@ -365,7 +365,12 @@ def cmd_tail(args) -> int:
 def cmd_check(args) -> int:
     from heat3d_tpu.obs.check import main as check_main
 
-    return check_main(args.ledgers)
+    flags = []
+    if args.taxonomy:
+        flags.append("--taxonomy")
+    if args.start_line != 1:
+        flags.extend(["--start-line", str(args.start_line)])
+    return check_main(flags + args.ledgers)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -400,6 +405,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     c = sub.add_parser("check", help="schema lint (same as scripts/check_ledger.py)")
     c.add_argument("ledgers", nargs="+")
+    c.add_argument(
+        "--taxonomy", action="store_true",
+        help="also audit event names against the canonical registry "
+        "(heat3d_tpu/analysis/registry.py)",
+    )
+    c.add_argument(
+        "--start-line", type=int, default=1,
+        help="report only defects at/after this line (append-mode "
+        "session scoping)",
+    )
     c.set_defaults(fn=cmd_check)
 
     # listed for --help discoverability; dispatched above before parsing

@@ -193,7 +193,8 @@ class Ledger:
             self._seq += 1
             try:
                 line = json.dumps(record, default=repr)
-            except (TypeError, ValueError):
+            except Exception:  # noqa: BLE001 - default=repr runs arbitrary
+                # __repr__, so any exception class can surface here
                 # a bad field must not kill the run being observed — AND
                 # the salvage record must stay schema-valid (a span
                 # stripped of its span fields would fail the project's own
@@ -207,10 +208,25 @@ class Ledger:
                     try:
                         json.dumps(v, default=repr)
                         salvaged[k] = v
-                    except (TypeError, ValueError):
+                    except Exception:  # noqa: BLE001 - same repr exposure
                         dropped.append(k)
                 salvaged["malformed_fields"] = dropped
-                line = json.dumps(salvaged, default=repr)
+                try:
+                    line = json.dumps(salvaged, default=repr)
+                except Exception:  # noqa: BLE001
+                    # a value whose repr itself raises: drop to the
+                    # envelope alone, hand-formatted — the fields are
+                    # self-constructed primitives, so this cannot raise
+                    # and the record stays schema-valid
+                    line = (
+                        '{"ts": %r, "run_id": "%s", "proc": %d, "seq": %d,'
+                        ' "event": "%s", "kind": "%s",'
+                        ' "salvage_failed": true}'
+                        % (
+                            record["ts"], record["run_id"], record["proc"],
+                            record["seq"], record["event"], record["kind"],
+                        )
+                    )
             try:
                 self._f.write(line + "\n")
                 self._f.flush()
@@ -254,7 +270,16 @@ class Ledger:
     def close(self, **fields: Any) -> None:
         self._write("ledger_close", "point", fields)
         with self._lock:
-            self._f.close()
+            try:
+                self._f.close()
+            except OSError as e:
+                # close flushes; ENOSPC at the final flush must not turn
+                # a completed run's exit path into a crash (the fail-soft
+                # invariant heat3d lint enforces on this surface)
+                print(
+                    f"heat3d: ledger {self.path} close failed ({e})",
+                    file=sys.stderr,
+                )
 
     @property
     def active(self) -> bool:

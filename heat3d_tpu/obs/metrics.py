@@ -283,7 +283,10 @@ def export_at_exit(registry: Optional[MetricsRegistry] = None) -> Optional[str]:
         return None
     try:
         (registry or REGISTRY).write_snapshot(path)
-    except OSError as e:
+    except (OSError, TypeError, ValueError) as e:
+        # OSError: unwritable path; TypeError/ValueError: a snapshot
+        # value json.dumps rejects — either way the run's results already
+        # printed, so export degrades to a stderr note (fail-soft)
         import sys
 
         print(f"heat3d: metrics export to {path} failed: {e}", file=sys.stderr)

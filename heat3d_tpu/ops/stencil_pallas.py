@@ -173,6 +173,12 @@ def _stream_vmem_bytes(
 # tuned to leave Mosaic headroom.
 _STREAM_VMEM_BUDGET = 12 * 1024 * 1024
 
+# Fused multi-update streaming kernels (stream2 / streamk): the extra
+# intermediate rings buy a slightly higher explicit-buffer ceiling. One
+# named constant shared by both gates (and audited against per-chip
+# VMEM capacities by `heat3d lint`'s vmem-budget checker).
+_FUSED_STREAM_VMEM_BUDGET = 13 * 1024 * 1024
+
 # Mosaic reserves scoped-VMEM *stack* for the tap chain's plane-sized
 # compute-dtype temporaries — empirically ~n_taps live planes. The stack
 # pool is capped by the compiler at 16 MB (its default scoped-vmem limit
@@ -317,7 +323,7 @@ def stream2_supported(
     ny, nz = shape[1], shape[2]
     return (
         _stream2_vmem_bytes(shape, in_itemsize, out_itemsize)
-        <= 13 * 1024 * 1024
+        <= _FUSED_STREAM_VMEM_BUDGET
         and _tap_stack_bytes(ny + 2, nz + 2, n_taps, compute_itemsize)
         <= _TAP_STACK_BUDGET
     )
@@ -545,7 +551,7 @@ def streamk_supported(
     return (
         min(shape) >= k
         and _streamk_vmem_bytes(shape, k, in_itemsize, out_itemsize)
-        <= 13 * 1024 * 1024
+        <= _FUSED_STREAM_VMEM_BUDGET
         and _tap_stack_bytes(
             ny + 2 * (k - 1), nz + 2 * (k - 1), n_taps, compute_itemsize
         )

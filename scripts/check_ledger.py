@@ -1,6 +1,7 @@
 #!/usr/bin/env python
-"""Ledger schema lint — thin wrapper over heat3d_tpu.obs.check so the CI
-gate (scripts/run_bench_suite.sh) and the operator command
+"""Ledger schema lint — thin wrapper over the promoted data-lint core
+(heat3d_tpu.analysis.ledgerlint, re-exported through heat3d_tpu.obs.check)
+so the CI gate (scripts/run_bench_suite.sh) and the operator command
 (``heat3d obs check``) share one implementation.
 
 Checks every ledger file given: required fields on every event, span
@@ -9,8 +10,10 @@ run-id consistency (each run segment opens with exactly one
 ``ledger_open``). rc 1 on any defect. ``--start-line N`` scopes the
 report to defects at/after line N (APPEND-mode suite sessions lint only
 the segments they wrote — same rule as check_provenance.py).
+``--taxonomy`` additionally audits event names against the canonical
+registry (heat3d_tpu/analysis/registry.py).
 
-Usage: scripts/check_ledger.py [--start-line N] LEDGER.jsonl [...]
+Usage: scripts/check_ledger.py [--taxonomy] [--start-line N] LEDGER.jsonl [...]
 """
 
 import os

@@ -1,5 +1,8 @@
 #!/usr/bin/env python
-"""Provenance lint for bench result records.
+"""Provenance lint for bench result records — thin wrapper over the
+promoted data-lint core (heat3d_tpu.analysis.provenance), keeping the
+established flags; the analysis subsystem owns the rules and shares its
+finding/report format with ``heat3d lint`` (docs/ANALYSIS.md).
 
 Round 5 shipped 21 live on-chip rows whose ``ts`` field was null — the
 timestamp stamping landed AFTER the healthy window that measured the rows
@@ -11,150 +14,37 @@ class of gap loud at measurement time instead of at judging time: it FAILS
   session measured it), or
 - is a throughput row missing its route-provenance fields (``platform``,
   ``direct_path``, ``mehrstellen_route``, ``fused_dma_path``,
-  ``fused_dma_emulated``, ``chain_ops`` — ``chain_ops: null`` is legal
-  only for ``backend: conv``, where a tap-chain op count does not exist), or
+  ``fused_dma_emulated``, ``streamk_path``, ``streamk_emulated``,
+  ``chain_ops`` — ``chain_ops: null`` is legal only for ``backend:
+  conv``, where a tap-chain op count does not exist), or
+- is a ``time_blocking > 1`` throughput row missing a numeric
+  ``cost_redundant_flops_frac`` (deep-tb recompute honesty), or
 - is a halo row missing ``platform``, or
-- is a bench row (either kind) missing a numeric ``sync_rtt_s`` — the
-  measured host round trip stamped by the harness (cached per backend in
-  utils.timing.sync_overhead); without it an ``rtt_dominated`` sample
-  cannot be audited from the row alone. A sweep JOURNAL recorded before
-  this field existed re-emits its rows verbatim on resume (byte-identical
-  replay is the journal's contract), so those replays fail too — by
-  design, same as legacy ``ts`` rows: re-land them in a healthy window or
-  start a fresh journal; do not weaken the lint.
+- is a bench row (either kind) missing a numeric ``sync_rtt_s``.
 
 Wired into the bench report path (scripts/run_bench_suite.sh runs it after
 regenerating BASELINE.md, and its rc is the suite's rc), so a session
 cannot complete "green" while writing unprovenanced rows. APPEND-mode
 sessions scope the lint with ``--start-line N`` to the rows THEY wrote —
-otherwise the committed legacy record (15 pre-``ts`` rows) would keep
-every resumed session permanently red and the gate would stop meaning
-anything. A bare run over the whole file still fails on legacy rows by
-design — the fix is re-landing the suite in a healthy window, not
-weakening the lint.
+a bare run over a whole legacy file still fails on legacy rows by design:
+the fix is re-landing the suite in a healthy window, not weakening the
+lint.
 
 Usage: scripts/check_provenance.py [--start-line N] RESULTS.jsonl [...]
 """
 
-from __future__ import annotations
-
-import json
+import os
 import sys
 
-ROUTE_FIELDS = (
-    "platform",
-    "direct_path",
-    "mehrstellen_route",
-    "fused_dma_path",
-    "fused_dma_emulated",
-    "streamk_path",
-    "streamk_emulated",
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from heat3d_tpu.analysis.provenance import (  # noqa: E402,F401
+    MAX_REPORT,
+    ROUTE_FIELDS,
+    check_file,
+    check_row,
+    main,
 )
-MAX_REPORT = 20
-
-
-def check_row(r: dict) -> list:
-    problems = []
-    ts = r.get("ts")
-    if not (isinstance(ts, str) and ts):
-        problems.append(
-            "ts missing/null (row cannot prove its measurement session)"
-        )
-    if r.get("bench") == "throughput":
-        for f in ROUTE_FIELDS:
-            if f not in r:
-                problems.append(f"missing route-provenance field {f!r}")
-        if "chain_ops" not in r:
-            problems.append("missing route-provenance field 'chain_ops'")
-        elif r["chain_ops"] is None and r.get("backend") != "conv":
-            problems.append(
-                "chain_ops is null on a non-conv row (op-count provenance "
-                "lost)"
-            )
-        # temporally-blocked rows execute redundant ghost-ring recompute;
-        # without the recorded fraction their Gcell/s cannot be discounted
-        # to useful work at judging time (deep-tb honesty — a tb=4 "win"
-        # must carry its own recompute tax on the row)
-        tb = r.get("time_blocking", 1)
-        if isinstance(tb, int) and tb > 1 and not isinstance(
-            r.get("cost_redundant_flops_frac"), (int, float)
-        ):
-            problems.append(
-                "cost_redundant_flops_frac missing/non-numeric on a "
-                f"time_blocking={tb} row (redundant-compute provenance "
-                "lost)"
-            )
-    elif r.get("bench") == "halo":
-        if "platform" not in r:
-            problems.append("missing 'platform'")
-    if r.get("bench") in ("throughput", "halo") and not isinstance(
-        r.get("sync_rtt_s"), (int, float)
-    ):
-        problems.append(
-            "sync_rtt_s missing/non-numeric (RTT-dominated samples not "
-            "auditable from the row)"
-        )
-    return problems
-
-
-def check_file(path: str, start_line: int = 1) -> list:
-    """(line_no, description) for every defect in ``path`` at or after
-    ``start_line`` (1-based; earlier lines belong to a prior session)."""
-    bad = []
-    try:
-        f = open(path)
-    except OSError as e:
-        return [(0, f"cannot open {path}: {e}")]
-    with f:
-        for i, line in enumerate(f, start=1):
-            if i < start_line:
-                continue
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                r = json.loads(line)
-            except json.JSONDecodeError:
-                bad.append((i, "unparseable JSON"))
-                continue
-            if not isinstance(r, dict) or r.get("bench") not in (
-                "throughput",
-                "halo",
-            ):
-                continue  # foreign lines (headline records, notes) pass
-            for p in check_row(r):
-                bad.append((i, p))
-    return bad
-
-
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    start_line = 1
-    if argv and argv[0] == "--start-line":
-        if len(argv) < 2:
-            print("--start-line needs a value", file=sys.stderr)
-            return 2
-        start_line = int(argv[1])
-        argv = argv[2:]
-    if not argv:
-        print(__doc__, file=sys.stderr)
-        return 2
-    failed = False
-    for path in argv:
-        bad = check_file(path, start_line)
-        if not bad:
-            print(f"provenance ok: {path}")
-            continue
-        failed = True
-        print(
-            f"provenance FAIL: {path}: {len(bad)} defect(s)", file=sys.stderr
-        )
-        for line_no, desc in bad[:MAX_REPORT]:
-            print(f"  {path}:{line_no}: {desc}", file=sys.stderr)
-        if len(bad) > MAX_REPORT:
-            print(f"  ... and {len(bad) - MAX_REPORT} more", file=sys.stderr)
-    return 1 if failed else 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

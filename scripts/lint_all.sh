@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# One-shot pre-merge lint sweep (docs/ANALYSIS.md):
+#
+#   1. `heat3d lint` — the five static checkers over the source tree
+#      (rc 1 only on unsuppressed error-severity findings);
+#   2. ledger data lint WITH the taxonomy audit over every *ledger*.jsonl
+#      argument (event names checked against the canonical registry);
+#   3. provenance lint over every other .jsonl argument (bench rows).
+#
+# Usage: scripts/lint_all.sh [artifact.jsonl ...]
+#   scripts/lint_all.sh                                   # static only
+#   scripts/lint_all.sh bench_results.jsonl bench_results.ledger.jsonl
+#
+# Arguments are routed by name: a .jsonl containing "ledger" gets the
+# ledger lint, any other .jsonl the provenance lint. Data lints here run
+# UNSCOPED (no --start-line) on purpose — pre-merge, the whole artifact
+# is the thing being vouched for; session-scoped linting is the bench
+# suite's job. rc is nonzero if ANY stage failed, and every stage runs
+# (one red lint must not hide another).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== static analysis (heat3d lint) =="
+python -m heat3d_tpu.cli lint || rc=1
+
+for artifact in "$@"; do
+  case "$artifact" in
+    *ledger*.jsonl)
+      echo "== ledger lint (--taxonomy): $artifact =="
+      python scripts/check_ledger.py --taxonomy "$artifact" || rc=1
+      ;;
+    *.jsonl)
+      echo "== provenance lint: $artifact =="
+      python scripts/check_provenance.py "$artifact" || rc=1
+      ;;
+    *)
+      echo "lint_all: skipping unrecognized artifact $artifact" >&2
+      ;;
+  esac
+done
+
+exit "$rc"

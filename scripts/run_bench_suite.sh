@@ -238,6 +238,16 @@ python -m heat3d_tpu.obs.cli roofline "$OUT" \
 # under set -e; the regress JSON verdict also lands in the suite log.
 python scripts/check_provenance.py --start-line "$LINT_FROM" "$OUT"
 python scripts/check_ledger.py --start-line "$LEDGER_LINT_FROM" "$LEDGER"
+# Static-analysis gate (docs/ANALYSIS.md): SPMD-safety + invariant
+# checkers over the source tree; rc 1 only on unsuppressed error-severity
+# findings, and that rc is the suite's rc. SKIP_STATIC_LINT=1 is the
+# escape hatch for sessions that must land rows while a lint fix is in
+# flight (scripts/lint_all.sh still runs it pre-merge).
+if [[ -z "${SKIP_STATIC_LINT:-}" ]]; then
+  python -m heat3d_tpu.cli lint --json | tee -a "$SUITE_LOG"
+else
+  note "suite: static lint skipped (SKIP_STATIC_LINT=1)"
+fi
 python -m heat3d_tpu.obs.cli regress "$OUT" --start-line "$LINT_FROM" \
   --json | tee -a "$SUITE_LOG"
 

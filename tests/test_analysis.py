@@ -1,0 +1,784 @@
+"""Static-analysis subsystem tests (tier-1, CPU): every checker fires on
+a seeded-violation fixture and stays quiet on compliant code, the
+finding/suppression framework (fingerprints, inline ok-comments,
+baseline round trip, rc policy) behaves as docs/ANALYSIS.md promises,
+the promoted data-lint cores keep their scripted behavior, and — the
+acceptance gate — `heat3d lint --json` is clean on this repo itself."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from heat3d_tpu.analysis import CHECKERS, astutil, collectives, failsoft
+from heat3d_tpu.analysis import knobs as knobs_checker
+from heat3d_tpu.analysis import ledgerlint, provenance, taxonomy, vmem
+from heat3d_tpu.analysis.cli import main as lint_main
+from heat3d_tpu.analysis.cli import run_checkers
+from heat3d_tpu.analysis.findings import (
+    ERROR,
+    WARNING,
+    Finding,
+    apply_suppressions,
+    exit_code,
+    load_baseline,
+    write_baseline,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ast_cache():
+    """Fixture files are rewritten across tests under tmp paths; a stale
+    parse cache would cross-contaminate them."""
+    astutil.clear_cache()
+    yield
+    astutil.clear_cache()
+
+
+def _write(tmp_path, relpath, source):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return str(path)
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---- collective-divergence ----------------------------------------------
+
+
+BAD_COLLECTIVES = """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def process_guarded(u):
+        if jax.process_index() == 0:
+            u = lax.ppermute(u, "x", [(0, 1)])
+        return u
+
+    def taint_guarded(u):
+        pid = jax.process_index()
+        if pid == 0:
+            u = lax.psum(u, "x")
+        return u
+
+    def device_guarded(u):
+        idx = lax.axis_index("x")
+        if idx == 0:
+            u = lax.psum(u, "x")
+        return u
+
+    def data_guarded(u, thresh):
+        if float(jnp.max(u)) > thresh:
+            u = lax.psum(u, "x")
+        return u
+
+    def wraps_collective(u):
+        return lax.ppermute(u, "x", [(0, 1)])
+
+    def indirect_guarded(u):
+        if jax.process_index() == 0:
+            u = wraps_collective(u)
+        return u
+"""
+
+GOOD_COLLECTIVES = """
+    from jax import lax
+
+    def uniform_guard(u, periodic):
+        if periodic:
+            u = lax.ppermute(u, "x", [(0, 1)])
+        return u
+
+    def unguarded(u):
+        return lax.psum(u, "x")
+
+    def no_collective(u):
+        if float(u.sum()) > 0:
+            return u * 2
+        return u
+"""
+
+
+def test_collective_divergence_fires_on_seeded_hazards(tmp_path):
+    path = _write(tmp_path, "pkg/bad_coll.py", BAD_COLLECTIVES)
+    found = collectives.check(str(tmp_path), files=[path])
+    by_sym = {f.symbol: f for f in found}
+    # every seeded hazard flagged, each with the right divergence class
+    assert by_sym["process_guarded"].code == "ANL101"
+    assert by_sym["taint_guarded"].code == "ANL101"
+    assert by_sym["device_guarded"].code == "ANL102"
+    assert by_sym["data_guarded"].code == "ANL103"
+    # the call-graph fixpoint sees through the wrapper
+    assert by_sym["indirect_guarded"].code == "ANL101"
+    assert "collective-bearing" in by_sym["indirect_guarded"].message
+    assert all(f.severity == ERROR for f in found)
+    # the unguarded wrapper itself is not a finding
+    assert "wraps_collective" not in by_sym
+
+
+def test_collective_divergence_quiet_on_uniform_guards(tmp_path):
+    path = _write(tmp_path, "pkg/good_coll.py", GOOD_COLLECTIVES)
+    assert collectives.check(str(tmp_path), files=[path]) == []
+
+
+# ---- fail-soft enforcement ----------------------------------------------
+
+
+LEAKY_OBS = """
+    import json
+
+    def leaky_write(path, payload):
+        with open(path, "w") as f:
+            f.write(payload)
+
+    def leaky_encode(payload):
+        return json.dumps(payload)
+
+    def guarded_write(path, payload):
+        try:
+            with open(path, "w") as f:
+                f.write(payload)
+        except OSError:
+            pass
+
+    def calls_leaky(path):
+        leaky_write(path, "x")
+
+    def guards_leaky_call(path):
+        try:
+            leaky_write(path, "x")
+        except Exception:
+            pass
+"""
+
+
+def test_failsoft_fires_on_leaky_and_propagated_io(tmp_path):
+    relp = "obspkg/telemetry.py"
+    _write(tmp_path, relp, LEAKY_OBS)
+    contract = {
+        relp: (
+            "leaky_write",
+            "leaky_encode",
+            "guarded_write",
+            "calls_leaky",
+            "guards_leaky_call",
+        )
+    }
+    found = failsoft.check(str(tmp_path), contract=contract)
+    by_sym = {f.symbol: f for f in found}
+    assert by_sym["leaky_write"].code == "ANL201"
+    assert "OSError" in by_sym["leaky_write"].message
+    assert "TypeError" in by_sym["leaky_encode"].message
+    # risk propagates caller-ward through the intra-package call graph...
+    assert by_sym["calls_leaky"].code == "ANL201"
+    # ...but a guard at either layer absorbs it
+    assert "guarded_write" not in by_sym
+    assert "guards_leaky_call" not in by_sym
+
+
+def test_failsoft_flags_contract_naming_missing_function(tmp_path):
+    relp = "obspkg/telemetry.py"
+    _write(tmp_path, relp, "def present():\n    pass\n")
+    found = failsoft.check(
+        str(tmp_path), contract={relp: ("present", "renamed_away")}
+    )
+    assert _codes(found) == ["ANL202"]
+    assert found[0].symbol == "renamed_away"
+
+
+def test_failsoft_live_obs_surface_is_clean():
+    """The real contract over the real obs/ package: the PR 2 invariant,
+    mechanically enforced from here on."""
+    assert failsoft.check(REPO) == []
+
+
+# ---- vmem-budget ---------------------------------------------------------
+
+
+BAD_VMEM = """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def build(kernel, out_shape, dtype):
+        return pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((4, 8, 128), dtype)],
+        )
+"""
+
+GOOD_VMEM = """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def build(kernel, out_shape, dtype, nslots):
+        return pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((3, 8, 128), dtype),
+                pltpu.VMEM((nslots, 8, 128), dtype),  # dynamic: shape math
+            ],
+            cost_estimate=pl.CostEstimate(
+                flops=1, bytes_accessed=1, transcendentals=0
+            ),
+        )
+"""
+
+
+def test_vmem_ast_fires_on_missing_cost_and_bad_ring(tmp_path):
+    path = _write(tmp_path, "pkg/bad_kernel.py", BAD_VMEM)
+    found = vmem.check(str(tmp_path), files=[path])
+    assert _codes(found) == ["ANL301", "ANL302"]
+    ring = next(f for f in found if f.code == "ANL302")
+    assert "4 slots" in ring.message
+
+
+def test_vmem_ast_quiet_on_compliant_kernel(tmp_path):
+    path = _write(tmp_path, "pkg/good_kernel.py", GOOD_VMEM)
+    assert vmem.check(str(tmp_path), files=[path]) == []
+
+
+def test_vmem_budget_arithmetic_fires_on_tiny_chip():
+    """The budget audit drives the repo's real estimators: against a
+    fictional 4 MiB part every admit budget is over-ceiling."""
+    found = vmem.check(
+        REPO, files=[], chip_table={"tpu-tiny": 4 * vmem.MIB}
+    )
+    assert "ANL303" in _codes(found)
+    assert all(f.severity in (ERROR, WARNING) or f.code == "ANL307" for f in found)
+
+
+def test_vmem_budget_arithmetic_clean_on_real_chip_table():
+    """The repo's own budgets fit every known generation (the ANL305
+    fused-DMA note on 16 MiB parts is a documented warning, not an
+    error)."""
+    found = vmem.check(REPO, files=[], chip_table=dict(vmem.CHIP_VMEM_BYTES))
+    assert [f for f in found if f.severity == ERROR] == []
+
+
+# ---- ledger-taxonomy -----------------------------------------------------
+
+
+BAD_TAXONOMY = """
+    import os
+
+    def run(ledger):
+        ledger.event("unregistered_event")
+        with ledger.span("run_loop"):
+            pass
+        ledger.event("warmup")  # registered as a span
+        os.environ.get("HEAT3D_MYSTERY_KNOB")
+"""
+
+GOOD_TAXONOMY = """
+    def run(ledger):
+        ledger.event("run_start")
+        with ledger.span("run_loop"):
+            pass
+"""
+
+_EVENTS = {
+    "run_start": {"kind": "point", "desc": "x"},
+    "run_loop": {"kind": "span", "desc": "x"},
+    "warmup": {"kind": "span", "desc": "x"},
+    "stale_event": {"kind": "point", "desc": "x"},
+}
+
+
+def test_taxonomy_fires_on_drifted_vocabulary(tmp_path):
+    path = _write(tmp_path, "pkg/emitters.py", BAD_TAXONOMY)
+    # docs cover everything except stale_event and the mystery knob
+    _write(
+        tmp_path,
+        "docs/OBS.md",
+        "| `run_start` | point | x |\n"
+        "| `run_loop` | span | x |\n"
+        "| `warmup` | span | x |\n"
+        "| `HEAT3D_DOCUMENTED_KNOB` | x |\n",
+    )
+    found = taxonomy.check(
+        str(tmp_path),
+        files=[path],
+        events_registry=_EVENTS,
+        env_registry={"HEAT3D_DOCUMENTED_KNOB": {"desc": "x"}},
+        docs_path="docs/OBS.md",
+    )
+    codes = {f.code for f in found}
+    assert codes == {
+        "ANL401",  # unregistered_event emitted but not registered
+        "ANL402",  # warmup emitted as point, registered as span
+        "ANL403",  # stale_event / run_start registered, never emitted
+        "ANL404",  # stale_event missing from the docs table
+        "ANL411",  # HEAT3D_MYSTERY_KNOB referenced, unregistered
+        "ANL413",  # HEAT3D_DOCUMENTED_KNOB registered, never referenced
+    }
+    stale = [f for f in found if f.code == "ANL403"]
+    assert {f.symbol for f in stale} == {"stale_event", "run_start"}
+
+
+_GOOD_DOCS = (
+    "| `run_start` | point | x |\n"
+    "| `run_loop` | span | x |\n"
+)
+
+
+def test_taxonomy_quiet_on_registered_vocabulary(tmp_path):
+    path = _write(tmp_path, "pkg/emitters.py", GOOD_TAXONOMY)
+    _write(tmp_path, "docs/OBS.md", _GOOD_DOCS)
+    found = taxonomy.check(
+        str(tmp_path),
+        files=[path],
+        events_registry={
+            "run_start": {"kind": "point", "desc": "x"},
+            "run_loop": {"kind": "span", "desc": "x"},
+        },
+        env_registry={},
+        docs_path="docs/OBS.md",
+    )
+    assert found == []
+
+
+def test_taxonomy_docs_check_is_row_anchored(tmp_path):
+    """A deleted table row is caught even when its name is a prefix of a
+    surviving row's, and a docs row whose kind column drifted from the
+    registry is a finding too."""
+    path = _write(tmp_path, "pkg/emitters.py", GOOD_TAXONOMY)
+    registry = {
+        "run_start": {"kind": "point", "desc": "x"},
+        "run_loop": {"kind": "span", "desc": "x"},
+        "run": {"kind": "point", "desc": "x", "external": True},
+    }
+    # `run`'s own row was deleted; `run_start`/`run_loop` rows contain
+    # the substring "run" but must not satisfy the check
+    _write(tmp_path, "docs/OBS.md", _GOOD_DOCS)
+    found = taxonomy.check(
+        str(tmp_path), files=[path], events_registry=registry,
+        env_registry={}, docs_path="docs/OBS.md",
+    )
+    assert [(f.code, f.symbol) for f in found] == [("ANL404", "run")]
+    # kind drift: docs say warmup is a point, registry says span
+    _write(tmp_path, "docs/OBS2.md", _GOOD_DOCS + "| `warmup` | point | x |\n")
+    found = taxonomy.check(
+        str(tmp_path),
+        files=[path],
+        events_registry={
+            "run_start": {"kind": "point", "desc": "x"},
+            "run_loop": {"kind": "span", "desc": "x"},
+            "warmup": {"kind": "span", "desc": "x", "external": True},
+        },
+        env_registry={},
+        docs_path="docs/OBS2.md",
+    )
+    assert [(f.code, f.symbol) for f in found] == [("ANL404", "warmup")]
+
+
+def test_taxonomy_unreadable_docs_is_a_finding(tmp_path):
+    """A missing docs file must not silently disable the documentation
+    leg — it is itself an error finding (ANL405)."""
+    path = _write(tmp_path, "pkg/emitters.py", GOOD_TAXONOMY)
+    found = taxonomy.check(
+        str(tmp_path),
+        files=[path],
+        events_registry={
+            "run_start": {"kind": "point", "desc": "x"},
+            "run_loop": {"kind": "span", "desc": "x"},
+        },
+        env_registry={},
+        docs_path="docs/DOES_NOT_EXIST.md",
+    )
+    assert _codes(found) == ["ANL405"]
+    assert found[0].severity == ERROR
+
+
+def test_taxonomy_external_events_exempt_from_emission_check(tmp_path):
+    path = _write(tmp_path, "pkg/emitters.py", GOOD_TAXONOMY)
+    _write(tmp_path, "docs/OBS.md", _GOOD_DOCS + "| `child_only` | point | x |\n")
+    found = taxonomy.check(
+        str(tmp_path),
+        files=[path],
+        events_registry={
+            "run_start": {"kind": "point", "desc": "x"},
+            "run_loop": {"kind": "span", "desc": "x"},
+            "child_only": {"kind": "point", "desc": "x", "external": True},
+        },
+        env_registry={},
+        docs_path="docs/OBS.md",
+    )
+    assert found == []
+
+
+# ---- knob-drift ----------------------------------------------------------
+
+# a consistent five-surface snapshot to perturb per assertion
+_KNOBS = ("backend", "halo")
+_SPACE = ("backend", "halo", "mesh")
+_FLAGS = ("--backend", "--halo")
+_ROWS = {"backend", "halo", "platform"}
+_ROUTES = ("platform",)
+_DOC = "backend halo"
+
+
+def _drift(**kw):
+    args = dict(
+        knobs=_KNOBS,
+        space_keys=_SPACE,
+        cli_flags=_FLAGS,
+        row_strings=_ROWS,
+        route_fields=_ROUTES,
+        tuning_doc=_DOC,
+    )
+    args.update(kw)
+    return knobs_checker.check(REPO, **args)
+
+
+def test_knob_drift_quiet_on_agreeing_surfaces():
+    assert _drift() == []
+
+
+def test_knob_drift_fires_per_drifted_surface():
+    # a knob SolverConfig does not carry
+    assert "ANL501" in _codes(_drift(knobs=_KNOBS + ("bogus_knob",)))
+    # the lattice searching a non-knob
+    assert "ANL502" in _codes(_drift(space_keys=_SPACE + ("mystery",)))
+    # a knob the lattice never searches
+    assert "ANL503" in _codes(_drift(space_keys=("backend", "mesh")))
+    # a knob with no CLI flag
+    assert "ANL504" in _codes(_drift(cli_flags=("--backend",)))
+    # a knob bench rows never record
+    assert "ANL505" in _codes(_drift(row_strings={"backend", "platform"}))
+    # a provenance-required field the harness never writes
+    assert "ANL506" in _codes(_drift(route_fields=("platform", "new_route")))
+    # an undocumented knob
+    assert "ANL507" in _codes(_drift(tuning_doc="backend only"))
+
+
+def test_harness_row_keys_ignore_docstrings(tmp_path):
+    """'Recorded on bench rows' means a dict key (or string subscript
+    assignment), not any mention — a knob named only in a docstring must
+    still trip ANL505."""
+    _write(
+        tmp_path,
+        "harness.py",
+        '''
+        """Mentions halo_order and platform in prose only."""
+
+        def row(cfg):
+            r = {"backend": cfg.backend}
+            r["streamk_path"] = None
+            return r
+        ''',
+    )
+    keys = knobs_checker._harness_row_keys(str(tmp_path), "harness.py")
+    assert keys == {"backend", "streamk_path"}
+
+
+def test_knob_drift_live_surfaces_agree():
+    """The real SolverConfig/lattice/CLI/harness/docs cross-check — the
+    five surfaces agree today and this pins them together."""
+    assert knobs_checker.check(REPO) == []
+
+
+# ---- promoted data-lint cores -------------------------------------------
+
+
+def _ledger_lines(events):
+    return "\n".join(json.dumps(e) for e in events) + "\n"
+
+
+def _evt(seq, name, kind="point", **extra):
+    rec = dict(
+        ts=1000.0 + seq,
+        run_id="r1",
+        proc=0,
+        seq=seq,
+        event=name,
+        kind=kind,
+    )
+    rec.update(extra)
+    return rec
+
+
+def test_ledgerlint_taxonomy_flag_audits_stream_names(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(
+        _ledger_lines(
+            [
+                _evt(0, "ledger_open"),
+                _evt(1, "not_a_registered_event"),
+                _evt(2, "ledger_close"),
+            ]
+        )
+    )
+    # schema-only: clean; with --taxonomy: the foreign name is a defect
+    assert ledgerlint.check_file(str(path)) == []
+    defects = ledgerlint.check_file(str(path), taxonomy=True)
+    assert len(defects) == 1 and defects[0][0] == 2
+    assert "not_a_registered_event" in defects[0][1]
+    # and the finding-format view carries the shared schema
+    findings = ledgerlint.check_file_findings(str(path), taxonomy=True)
+    assert [f.code for f in findings] == ["DATA-LEDGER"]
+    assert findings[0].severity == ERROR
+
+
+def test_ledgerlint_schema_rules_survived_promotion(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(
+        _ledger_lines(
+            [
+                _evt(0, "ledger_open"),
+                _evt(0, "run_start"),  # seq not increasing
+                {"event": "residual"},  # missing required fields
+            ]
+        )
+    )
+    descs = [d for _, d in ledgerlint.check_file(str(path))]
+    assert any("seq" in d for d in descs)
+    assert any("missing required field" in d for d in descs)
+
+
+def test_obs_check_shim_still_exports_the_core():
+    from heat3d_tpu.obs import check as obs_check
+
+    assert obs_check.check_file is ledgerlint.check_file
+    assert obs_check.main is ledgerlint.main
+
+
+def test_provenance_findings_format(tmp_path):
+    path = tmp_path / "rows.jsonl"
+    path.write_text(json.dumps({"bench": "halo", "p50_ms": 1.0}) + "\n")
+    findings = provenance.check_file_findings(str(path))
+    assert findings and all(f.code == "DATA-PROV" for f in findings)
+    descs = " ".join(f.message for f in findings)
+    assert "ts" in descs and "sync_rtt_s" in descs
+
+
+def test_provenance_script_wrapper_delegates(tmp_path):
+    good = tmp_path / "rows.jsonl"
+    good.write_text(
+        json.dumps({"note": "foreign lines pass"}) + "\n"
+        + json.dumps(
+            {"bench": "halo", "ts": "t", "platform": "cpu", "sync_rtt_s": 0.1}
+        )
+        + "\n"
+    )
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"bench": "halo"}) + "\n")
+    run = lambda p: subprocess.run(  # noqa: E731
+        [sys.executable, "scripts/check_provenance.py", "--start-line", "1", p],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    ok = run(str(good))
+    assert ok.returncode == 0, ok.stderr
+    fail = run(str(bad))
+    assert fail.returncode == 1
+    assert "sync_rtt_s" in fail.stderr
+
+
+# ---- framework: suppression, baseline, rc --------------------------------
+
+
+def _finding(line=7, message="collective 'lax.psum' is guarded"):
+    return Finding(
+        checker="collective-divergence",
+        severity=ERROR,
+        path="pkg/bad_coll.py",
+        line=line,
+        code="ANL101",
+        symbol="process_guarded",
+        message=message,
+    )
+
+
+def test_fingerprint_is_line_and_number_free():
+    assert _finding(line=7).fingerprint() == _finding(line=99).fingerprint()
+    anon = Finding(
+        checker="c", severity=ERROR, path="p.py", line=1, code="X",
+        message="budget 12 MiB over 16 MiB cap",
+    )
+    renum = Finding(
+        checker="c", severity=ERROR, path="p.py", line=2, code="X",
+        message="budget 13 MiB over 32 MiB cap",
+    )
+    assert anon.fingerprint() == renum.fingerprint()
+
+
+def test_inline_ok_comment_suppresses_only_named_checker(tmp_path):
+    path = _write(
+        tmp_path,
+        "pkg/bad_coll.py",
+        """
+        x = 1
+        """,
+    )
+    lines = ["# pad\n"] * 10
+    lines[6] = "    u = lax.psum(u, 'x')  # heat3d-lint: ok=collective-divergence\n"
+    with open(path, "w") as f:
+        f.writelines(lines)
+    kept, suppressed = apply_suppressions(str(tmp_path), [_finding(line=7)], {})
+    assert kept == [] and len(suppressed) == 1
+    # a different checker's finding on the same line is NOT suppressed
+    other = Finding(
+        checker="vmem-budget", severity=ERROR, path="pkg/bad_coll.py",
+        line=7, code="ANL301", message="m",
+    )
+    kept, suppressed = apply_suppressions(str(tmp_path), [other], {})
+    assert kept == [other]
+
+
+def test_baseline_round_trip_suppresses_grandfathered(tmp_path):
+    baseline_path = str(tmp_path / ".heat3d-lint-baseline.json")
+    f_old = _finding()
+    assert write_baseline(baseline_path, [f_old]) == 1
+    baseline = load_baseline(baseline_path)
+    kept, suppressed = apply_suppressions(str(tmp_path), [f_old], baseline)
+    assert kept == [] and suppressed == [f_old]
+    # a NEW finding (different symbol) is not grandfathered
+    f_new = Finding(
+        checker=f_old.checker, severity=ERROR, path=f_old.path, line=3,
+        code=f_old.code, symbol="fresh_function", message="m",
+    )
+    kept, _ = apply_suppressions(str(tmp_path), [f_new], baseline)
+    assert kept == [f_new]
+
+
+def test_write_baseline_keeps_still_firing_grandfathered(tmp_path, capsys):
+    """Regenerating the baseline while a grandfathered finding still
+    fires must keep it grandfathered — and entries owned by checkers not
+    run this invocation survive untouched."""
+    _write(tmp_path, "heat3d_tpu/bad.py", BAD_COLLECTIVES)
+    baseline = str(tmp_path / ".heat3d-lint-baseline.json")
+    args = ["--checker", "collective-divergence",
+            "--root", str(tmp_path), "--baseline", baseline]
+    assert lint_main(args + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    # regenerate again: the old (still-firing) entries must not drop out
+    assert lint_main(args + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    assert lint_main(args + ["--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["error"] == 0 and payload["suppressed"] > 0
+    # a single-checker regeneration must not wipe other checkers' entries
+    assert lint_main(
+        ["--checker", "vmem-budget", "--root", str(tmp_path),
+         "--baseline", baseline, "--write-baseline"]
+    ) == 0
+    capsys.readouterr()
+    entries = load_baseline(baseline)
+    assert any(
+        e["checker"] == "collective-divergence" for e in entries.values()
+    )
+
+
+def test_broken_baseline_hides_nothing(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text("{not json")
+    assert load_baseline(str(p)) == {}
+
+
+def test_rc_policy_errors_only():
+    warn = Finding(
+        checker="c", severity=WARNING, path="p", line=0, code="X", message="m"
+    )
+    assert exit_code([warn]) == 0
+    assert exit_code([warn, _finding()]) == 1
+    assert exit_code([]) == 0
+
+
+def test_crashed_checker_is_an_error_finding(monkeypatch):
+    # astutil has no check(); a checker that cannot run must read as red
+    monkeypatch.setitem(CHECKERS, "vmem-budget", "heat3d_tpu.analysis.astutil")
+    found = run_checkers(REPO, ["vmem-budget"])
+    assert _codes(found) == ["ANL000"]
+    assert found[0].severity == ERROR
+    # an unimportable checker is the same tripwire, not a traceback
+    monkeypatch.setitem(CHECKERS, "vmem-budget", "heat3d_tpu.analysis.gone")
+    assert _codes(run_checkers(REPO, ["vmem-budget"])) == ["ANL000"]
+
+
+def test_write_baseline_never_grandfathers_checker_crashes(
+    tmp_path, capsys, monkeypatch
+):
+    """A transiently broken checker at --write-baseline time must not be
+    permanently suppressed (its ANL000 fingerprint is anchored on the
+    checker name alone)."""
+    (tmp_path / "heat3d_tpu").mkdir()
+    baseline = str(tmp_path / ".heat3d-lint-baseline.json")
+    monkeypatch.setitem(
+        CHECKERS, "collective-divergence", "heat3d_tpu.analysis.astutil"
+    )
+    args = ["--checker", "collective-divergence",
+            "--root", str(tmp_path), "--baseline", baseline]
+    assert lint_main(args + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    assert load_baseline(baseline) == {}
+    assert lint_main(args) == 1  # the crash still reads red
+    capsys.readouterr()
+
+
+# ---- heat3d lint CLI -----------------------------------------------------
+
+
+def test_lint_cli_unknown_checker_rejected():
+    with pytest.raises(SystemExit):
+        lint_main(["--checker", "no-such-checker"])
+
+
+def test_lint_cli_single_checker_json(tmp_path, capsys):
+    rc = lint_main(["--checker", "knob-drift", "--json", "--root", REPO])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["checkers"] == ["knob-drift"]
+    assert payload["counts"]["error"] == 0
+
+
+def test_lint_cli_write_baseline_then_clean(tmp_path, capsys, monkeypatch):
+    """Grandfathering workflow: seeded errors -> rc 1; --write-baseline
+    -> rc 0 afterwards, and the JSON reports them as suppressed."""
+    _write(tmp_path, "heat3d_tpu/bad.py", BAD_COLLECTIVES)
+    baseline = str(tmp_path / ".heat3d-lint-baseline.json")
+    args = [
+        "--checker", "collective-divergence",
+        "--root", str(tmp_path), "--baseline", baseline,
+    ]
+    assert lint_main(args + ["--json"]) == 1
+    capsys.readouterr()
+    assert lint_main(args + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    rc = lint_main(args + ["--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["counts"]["error"] == 0
+    assert payload["suppressed"] > 0
+    # --no-suppress is the audit view: everything comes back
+    assert lint_main(args + ["--no-suppress"]) == 1
+    capsys.readouterr()
+
+
+def test_repo_is_lint_clean():
+    """Acceptance: `heat3d lint --json` over this repo has zero
+    unsuppressed error-severity findings — run exactly as CI runs it."""
+    out = subprocess.run(
+        [sys.executable, "-m", "heat3d_tpu.cli", "lint", "--json"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["counts"]["error"] == 0
+    assert set(payload["checkers"]) == set(CHECKERS)
+    errors = [f for f in payload["findings"] if f["severity"] == "error"]
+    assert errors == []
