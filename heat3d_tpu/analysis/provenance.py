@@ -70,6 +70,27 @@ def check_row(r: dict) -> list:
                 f"time_blocking={tb} row (redundant-compute provenance "
                 "lost)"
             )
+        # ensemble-workload honesty (PR 7): how many members does this
+        # rate aggregate? A packed batch's total Gcell/s is otherwise
+        # indistinguishable from a single-run rate at judging time (the
+        # per-member effective rate is gcell_per_sec / members_per_step;
+        # solo rows carry [1]/1, serve.bench rows carry [B]/B)
+        bs = r.get("batch_shape")
+        if not (
+            isinstance(bs, list)
+            and bs
+            and all(isinstance(x, int) and x >= 1 for x in bs)
+        ):
+            problems.append(
+                "batch_shape missing/invalid (ensemble-workload provenance "
+                "— a packed batch's total rate must say so on the row)"
+            )
+        mp = r.get("members_per_step")
+        if not (isinstance(mp, int) and mp >= 1):
+            problems.append(
+                "members_per_step missing/non-int (per-member effective "
+                "rate not derivable from the row)"
+            )
     elif r.get("bench") == "halo":
         if "platform" not in r:
             problems.append("missing 'platform'")

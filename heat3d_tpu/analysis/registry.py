@@ -120,6 +120,16 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                         "desc": "no entry for this context (static fallback)"},
     "tune_cache_stale": {"kind": "point", "module": "tune/cache.py",
                          "desc": "entry rejected: jax/schema/env mismatch"},
+    # serving (batched scenario engine)
+    "serve_submit": {"kind": "point", "module": "serve/queue.py",
+                     "desc": "scenario request enqueued (request_id, depth)"},
+    "serve_batch_start": {"kind": "point", "module": "serve/queue.py",
+                          "desc": "packed batch about to execute (members, "
+                                  "padded size, request ids, bucket)"},
+    "serve_batch": {"kind": "span", "module": "serve/queue.py",
+                    "desc": "one packed batch's execution bracket"},
+    "serve_result": {"kind": "point", "module": "serve/queue.py",
+                     "desc": "one request delivered (queue latency)"},
 }
 
 # Wrapper functions whose first argument is an event name (the taxonomy
@@ -204,6 +214,12 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
                            "desc": "internal: marks the killable child"},
     "HEAT3D_BENCH_ARGS": {"module": "scripts/tpu_measure_all.sh",
                           "desc": "extra flags threaded into bench rows"},
+    "HEAT3D_SERVE_QUEUE": {"module": "serve/queue.py",
+                           "desc": "pending-request depth cap (submit raises "
+                                   "when full; default 1024)"},
+    "HEAT3D_SERVE_MAX_BATCH": {"module": "serve/queue.py",
+                               "desc": "members per packed batch cap "
+                                       "(default 64)"},
 }
 
 

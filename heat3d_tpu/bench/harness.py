@@ -156,6 +156,15 @@ def bench_throughput(
         "halo_order": cfg.halo_order,
         "steps": steps,
         "steps_requested": steps_requested,
+        # ensemble-workload provenance (REQUIRED by check_provenance.py on
+        # every throughput row): the solo bench advances one member per
+        # step call. Ensemble rows (serve.bench.bench_ensemble_throughput)
+        # carry [B]/B here, and gcell_per_sec counts every member's
+        # updates — per-member effective rate = gcell_per_sec /
+        # members_per_step, which obs summary/regress report so a packed
+        # batch's total can never masquerade as a single-run rate.
+        "batch_shape": [1],
+        "members_per_step": 1,
         "seconds_best": best,
         "seconds_all": times,
         "sync_rtt": rtt,

@@ -225,6 +225,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from heat3d_tpu.analysis.cli import main as lint_main
 
         return lint_main(argv_l[1:])
+    # `heat3d serve ...` — the batched scenario engine's front-end
+    # (queue scenario requests -> shape-bucketed batches -> streamed
+    # results; docs/SERVING.md), dispatched like `obs`/`tune`
+    if argv_l and argv_l[0] == "serve":
+        from heat3d_tpu.serve.cli import main as serve_main
+
+        return serve_main(argv_l[1:])
     # A measurement script stopping this run with `timeout` (SIGTERM) must
     # release the axon pool's chip claim on the way out, not die holding it.
     from heat3d_tpu.utils.backendprobe import install_sigterm_exit

@@ -54,6 +54,9 @@ NOTABLE = (
     "tune_cache_miss",
     "tune_cache_stale",
     "peak_calibrated",
+    "serve_submit",
+    "serve_batch_start",
+    "serve_result",
     "run_end",
     "ledger_close",
 )
@@ -237,6 +240,38 @@ def roofline_lines(events: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def ensemble_lines(events: List[Dict[str, Any]]) -> List[str]:
+    """The ensemble section of a run summary: for every throughput
+    bench_row aggregating more than one member per step (the batched
+    scenario engine — docs/SERVING.md), print the total rate NEXT TO the
+    per-member effective rate, so a packed batch's aggregate can never
+    read as a single-run number. Empty for solo-only ledgers; never
+    raises (summary sections fail soft)."""
+    lines: List[str] = []
+    try:
+        for r in events:
+            if r.get("event") != "bench_row" or r.get("bench") != "throughput":
+                continue
+            m = r.get("members_per_step")
+            g = r.get("gcell_per_sec")
+            if not (
+                isinstance(m, int)
+                and m > 1
+                and isinstance(g, (int, float))
+            ):
+                continue
+            grid = "x".join(str(x) for x in (r.get("grid") or []))
+            bm = r.get("batch_mesh", 1)
+            lines.append(
+                f"   ensemble {grid} B={m} (batch_mesh={bm}): "
+                f"{g:.4g} Gcell/s total -> {g / m:.4g} Gcell/s/member "
+                f"effective"
+            )
+    except Exception:  # noqa: BLE001 - a summary section must not kill summary
+        return lines
+    return lines
+
+
 def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
     out = out or sys.stdout
     head = events[0]
@@ -294,6 +329,10 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
     for line in roofline_lines(events):
         print(line, file=out)
 
+    # ensemble section: packed-batch rows split total vs per-member rate
+    for line in ensemble_lines(events):
+        print(line, file=out)
+
     # timeline of notable events
     shown = 0
     for r in events:
@@ -308,6 +347,8 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
                 "reason", "status", "bench", "grid", "ok",
                 "key", "knobs", "applied", "speedup_vs_default",
                 "vector_gflops",
+                "request_id", "members", "padded", "queue_depth",
+                "batch_members", "queue_latency_s",
             )
             if k in r
         ]

@@ -89,6 +89,11 @@ def row_key(row: Dict[str, Any]) -> Optional[Tuple]:
             row.get("halo", "ppermute"),
             row.get("halo_order", "axis"),
             row.get("backend", "auto"),
+            # ensemble workload axis: a packed batch's aggregate rate must
+            # only ever baseline against the same batch shape — without
+            # this key leg an ensemble win would mask (or fake) a
+            # single-run regression (rows predating the field are solo)
+            tuple(row.get("batch_shape") or (1,)),
             _platform_class(row),
         )
     if bench == "halo":
@@ -202,11 +207,17 @@ def compare(
             continue
         bench = k[0]
         field, direction = METRICS[bench]
+        members = r.get("members_per_step", 1)
         label = {
             "throughput": lambda r=r: (
                 f"throughput {r.get('stencil', '7pt')} "
                 f"{'x'.join(map(str, r.get('grid') or []))} "
                 f"{r.get('dtype')} tb={r.get('time_blocking', 1)}"
+                + (
+                    f" B={members}"
+                    if isinstance(members, int) and members > 1
+                    else ""
+                )
             ),
             "halo": lambda r=r: (
                 f"halo {'x'.join(map(str, r.get('grid') or []))} "
@@ -245,17 +256,23 @@ def compare(
             status = "fail"
         elif delta > warn_pct:
             status = "warn"
-        comparisons.append(
-            {
-                "row": label,
-                "metric": field,
-                "platform": _platform_class(r),
-                "current": cur_v,
-                "baseline": baseline,
-                "regression_pct": round(delta, 2),
-                "status": status,
-            }
-        )
+        comp = {
+            "row": label,
+            "metric": field,
+            "platform": _platform_class(r),
+            "current": cur_v,
+            "baseline": baseline,
+            "regression_pct": round(delta, 2),
+            "status": status,
+        }
+        if bench == "throughput" and isinstance(members, int) and members > 1:
+            # per-member effective rate: the honest serving number — the
+            # aggregate counts every member's updates, so packing B
+            # members multiplies it even when each member got slower
+            comp["members_per_step"] = members
+            comp["current_per_member"] = cur_v / members
+            comp["baseline_per_member"] = baseline / members
+        comparisons.append(comp)
 
     statuses = [c["status"] for c in comparisons]
     verdict = (
@@ -470,10 +487,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             arrow = {"pass": "ok  ", "warn": "WARN", "fail": "FAIL"}[
                 c["status"]
             ]
+            per_member = (
+                f"  [{c['current_per_member']:.4g}/member]"
+                if "current_per_member" in c
+                else ""
+            )
             print(
                 f"  {arrow} {c['row']} [{c['platform']}]: "
                 f"{c['current']:.4g} vs best {c['baseline']:.4g} "
-                f"({c['regression_pct']:+.1f}% regression)"
+                f"({c['regression_pct']:+.1f}% regression){per_member}"
             )
         for n in report["no_baseline"]:
             print(f"  new  {n['row']} [{n['platform']}]: no baseline")

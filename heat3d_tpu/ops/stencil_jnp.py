@@ -71,6 +71,21 @@ def apply_taps_padded(
             ).astype(out_dtype)
     flat = flat_taps(taps)
     assert flat, "stencil has no taps"
+    acc = _chain_accumulate(
+        upc, flat, lambda w: jnp.asarray(w, compute_dtype)
+    )
+    return acc.astype(out_dtype)
+
+
+def _chain_accumulate(upc: jax.Array, flat, scalar) -> jax.Array:
+    """THE shifted-slice emission of the tap chain over a ghost-padded
+    compute-dtype array ``upc`` — one body shared by the baked-constant
+    path (:func:`apply_taps_padded`) and the parametric path
+    (:func:`apply_taps_padded_params`), so the two cannot drift in op
+    order (the cross-path bitwise contract the batched ensemble relies
+    on). ``scalar(w)`` embeds one tap weight; the plane/row caches are
+    the x/y-factoring reuse accumulate_taps' emission order assumes."""
+    nx, ny, nz = upc.shape[0] - 2, upc.shape[1] - 2, upc.shape[2] - 2
     cache = {}
 
     def plane(di):  # (nx, ny+2, nz+2)
@@ -89,10 +104,61 @@ def apply_taps_padded(
             return cache[key][:, :, 1 + dk : 1 + dk + nz]
         return src[:, 1 + dj : 1 + dj + ny, 1 + dk : 1 + dk + nz]
 
-    acc = accumulate_taps(
-        flat, term, lambda w: jnp.asarray(w, compute_dtype)
-    )
-    return acc.astype(out_dtype)
+    return accumulate_taps(flat, term, scalar)
+
+
+def emission_positions(flat):
+    """Representative (di, dj, dk) tap offsets, one per chain term, in the
+    exact ``scalar()`` consumption order of :func:`accumulate_taps` over
+    ``flat`` under the CURRENT factoring env. Factored terms (``"xsum"`` /
+    ``"ysum"``) are represented by their +1-side tap — by construction the
+    factoring only fires when the ±1 patterns carry equal weights, so the
+    +1 weight IS the shared weight. This is how the batched ensemble maps
+    a member's 3x3x3 tap values onto the parametric chain's weight vector
+    (serve/ensemble.py)."""
+    from heat3d_tpu.core.stencils import _CountToken
+
+    tok = _CountToken()
+    out = []
+
+    def term(di, dj, dk):
+        out.append(
+            (1 if di == "xsum" else di, 1 if dj == "ysum" else dj, dk)
+        )
+        return tok
+
+    accumulate_taps(flat, term, lambda w: tok)
+    return tuple(out)
+
+
+def apply_taps_padded_params(
+    up: jax.Array,
+    flat,
+    weights: jax.Array,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+) -> jax.Array:
+    """The PARAMETRIC tap apply: same emission as
+    :func:`apply_taps_padded` (one shared ``_chain_accumulate`` body) but
+    with the weights as a TRACED vector instead of baked constants —
+    ``weights[i]`` is the i-th chain term's weight in
+    :func:`emission_positions` order, already in ``compute_dtype`` (the
+    caller casts on the host so double->storage rounding matches the
+    baked path exactly). One compiled program then serves ANY coefficient
+    values — the batched ensemble's per-member diffusivity/dt axis
+    (serve/ensemble.py) without per-value recompilation. ``flat`` is the
+    NOMINAL flat-tap structure (shared footprint; values only steer the
+    factoring split, which every member's taps satisfy identically)."""
+    out_dtype = out_dtype or up.dtype
+    upc = up.astype(compute_dtype)
+    counter = [0]
+
+    def scalar(_w):
+        i = counter[0]
+        counter[0] += 1
+        return weights[i]
+
+    return _chain_accumulate(upc, flat, scalar).astype(out_dtype)
 
 
 def apply_taps_conv_padded(

@@ -97,7 +97,12 @@ def axis_ghosts(
     ghost_hi = lax.ppermute(
         lo_face, axis_name, _shift_perm(axis_size, -1, periodic)
     )
-    if not periodic and bc_value != 0.0:
+    # bc_value may be a TRACED scalar (the batched ensemble path threads a
+    # per-member boundary value through vmap — serve/ensemble.py); the
+    # 0.0 fast path then cannot be decided at trace time, and substituting
+    # unconditionally is value-identical (undelivered ppermute outputs are
+    # zero-filled, so where(edge, 0.0, ghost) == ghost).
+    if not periodic and (isinstance(bc_value, jax.Array) or bc_value != 0.0):
         idx = lax.axis_index(axis_name)
         ghost_lo = jnp.where(idx == 0, jnp.full_like(ghost_lo, bc_value), ghost_lo)
         ghost_hi = jnp.where(

@@ -56,14 +56,20 @@ def _solver_taps(cfg: SolverConfig) -> np.ndarray:
     )
 
 
-def _pin_padding(u_new: jax.Array, cfg: SolverConfig) -> jax.Array:
+def _pin_padding(
+    u_new: jax.Array, cfg: SolverConfig, bc_value=None
+) -> jax.Array:
     """For uneven decompositions, re-pin storage-padding cells (global index
     >= grid extent) to bc_value after each update. Real cells adjacent to
     the true boundary then read bc_value from their padded neighbors —
     exactly the Dirichlet ghost — and padded cells contribute zero to the
-    residual (old == new == bc_value). Must run inside shard_map."""
+    residual (old == new == bc_value). Must run inside shard_map.
+    ``bc_value`` overrides the config's (may be a TRACED scalar — the
+    batched ensemble path's per-member boundary value, serve/ensemble)."""
     if not cfg.is_padded:
         return u_new
+    if bc_value is None:
+        bc_value = cfg.stencil.bc_value
     mask = None
     for axis, (name, g, n) in enumerate(
         zip(cfg.mesh.axis_names, cfg.grid.shape, cfg.local_shape)
@@ -75,7 +81,7 @@ def _pin_padding(u_new: jax.Array, cfg: SolverConfig) -> jax.Array:
         shape[axis] = n
         m = (global_idx < g).reshape(shape)
         mask = m if mask is None else jnp.logical_and(mask, m)
-    return jnp.where(mask, u_new, jnp.asarray(cfg.stencil.bc_value, u_new.dtype))
+    return jnp.where(mask, u_new, jnp.asarray(bc_value, u_new.dtype))
 
 
 def exchange(
@@ -110,14 +116,18 @@ def exchange(
 
 
 def _pin_outside_domain(
-    arr: jax.Array, cfg: SolverConfig, local_indices
+    arr: jax.Array, cfg: SolverConfig, local_indices, bc_value=None
 ) -> jax.Array:
     """Pin cells of ``arr`` whose GLOBAL index lies outside the domain to
     bc_value (Dirichlet; periodic has no out-of-domain cells — wrap ghosts
     are genuine). ``local_indices[a]`` gives each dim's local indices
-    (local i maps to global device_start + i). Must run inside shard_map."""
+    (local i maps to global device_start + i). Must run inside shard_map.
+    ``bc_value`` overrides the config's (may be a TRACED scalar — the
+    batched ensemble path's per-member boundary value, serve/ensemble)."""
     if cfg.stencil.bc is BoundaryCondition.PERIODIC:
         return arr
+    if bc_value is None:
+        bc_value = cfg.stencil.bc_value
     mask = None
     for axis, (name, g, n) in enumerate(
         zip(cfg.mesh.axis_names, cfg.grid.shape, cfg.local_shape)
@@ -128,11 +138,11 @@ def _pin_outside_domain(
         shape[axis] = arr.shape[axis]
         m = m.reshape(shape)
         mask = m if mask is None else jnp.logical_and(mask, m)
-    return jnp.where(mask, arr, jnp.asarray(cfg.stencil.bc_value, arr.dtype))
+    return jnp.where(mask, arr, jnp.asarray(bc_value, arr.dtype))
 
 
 def _fill_mid_ghosts(
-    mid: jax.Array, cfg: SolverConfig, rings: int = 1
+    mid: jax.Array, cfg: SolverConfig, rings: int = 1, bc_value=None
 ) -> jax.Array:
     """Between the applications of a temporally-blocked superstep, pin the
     cells of the ring-carrying intermediate that are NOT true interior
@@ -144,6 +154,7 @@ def _fill_mid_ghosts(
         mid,
         cfg,
         [jnp.arange(-rings, n + rings) for n in cfg.local_shape],
+        bc_value=bc_value,
     )
 
 

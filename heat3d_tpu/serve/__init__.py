@@ -1,0 +1,18 @@
+"""Batched scenario engine: the ensemble axis + the solver-as-a-service
+front-end (docs/SERVING.md).
+
+Layer 1 — :mod:`heat3d_tpu.serve.scenario` / :mod:`heat3d_tpu.serve.ensemble`:
+a ``ScenarioBatch`` (per-member initial condition, boundary value,
+diffusivity/dt, step budget over one shared structural config) and an
+``EnsembleSolver`` that threads a leading batch dimension through the
+distributed step — one compiled SPMD program advances every member.
+
+Layer 2 — :mod:`heat3d_tpu.serve.queue` / ``heat3d serve``: a request
+queue that packs compatible scenario submissions into shape-bucketed
+batches, executes them through cached compiled ensembles, and streams
+per-member results back with ledger spans and queue metrics.
+"""
+
+from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch  # noqa: F401
+from heat3d_tpu.serve.ensemble import EnsembleSolver  # noqa: F401
+from heat3d_tpu.serve.queue import ScenarioQueue  # noqa: F401

@@ -1,0 +1,260 @@
+"""``heat3d serve`` — the solver-as-a-service front-end (docs/SERVING.md).
+
+Modes::
+
+    heat3d serve --smoke                 # tiny 2-scenario CPU-safe batch
+    heat3d serve --requests FILE.jsonl   # submit scenarios, stream results
+    heat3d serve --bench [--members B]   # one ensemble throughput row
+
+``--requests`` reads one scenario per JSONL line::
+
+    {"grid": 64, "stencil": "7pt", "bc": "dirichlet", "bc_value": 1.0,
+     "alpha": 0.5, "dt": null, "steps": 100, "init": "hot-cube",
+     "seed": 0, "mesh": [1, 1, 1], "dtype": "fp32",
+     "time_blocking": 1}
+
+Requests sharing a structural bucket (grid/stencil/bc/mesh/dtype/knobs)
+pack into one compiled batch; results stream to stdout as JSON lines in
+submission order (``--out DIR`` additionally saves each final field as
+``req-<id>.npy``). Per-request ledger spans (``serve_submit`` /
+``serve_batch_start`` / ``serve_result``) and queue metrics land in the
+run ledger / metrics registry like every other entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from heat3d_tpu import obs
+from heat3d_tpu.utils.logging import get_logger
+
+log = get_logger("heat3d.serve")
+
+
+def _base_from_record(rec: dict):
+    from heat3d_tpu.core.config import (
+        BoundaryCondition,
+        GridConfig,
+        MeshConfig,
+        Precision,
+        RunConfig,
+        SolverConfig,
+        StencilConfig,
+    )
+
+    grid = rec.get("grid", 64)
+    shape = tuple(grid if isinstance(grid, list) else [grid] * 3)
+    mesh = rec.get("mesh")
+    if mesh is None:
+        mesh_cfg = MeshConfig()
+    elif isinstance(mesh, list) and len(mesh) == 3:
+        mesh_cfg = MeshConfig(shape=tuple(mesh))
+    else:
+        raise ValueError(f"request mesh must be [Px, Py, Pz], got {mesh!r}")
+    dtype = rec.get("dtype", "fp32")
+    return SolverConfig(
+        grid=GridConfig(
+            shape=shape, spacing=tuple(rec.get("spacing", (1.0, 1.0, 1.0)))
+        ),
+        stencil=StencilConfig(
+            kind=rec.get("stencil", "7pt"),
+            bc=BoundaryCondition(rec.get("bc", "dirichlet")),
+        ),
+        mesh=mesh_cfg,
+        precision=Precision.bf16() if dtype == "bf16" else Precision.fp32(),
+        run=RunConfig(num_steps=int(rec.get("steps", 100))),
+        backend="jnp",
+        halo="ppermute",
+        time_blocking=int(rec.get("time_blocking", 1)),
+    )
+
+
+def _scenario_from_record(rec: dict):
+    from heat3d_tpu.serve.scenario import Scenario
+
+    return Scenario(
+        init=rec.get("init", "hot-cube"),
+        alpha=float(rec.get("alpha", 1.0)),
+        dt=rec.get("dt"),
+        bc_value=float(rec.get("bc_value", 0.0)),
+        steps=rec.get("steps"),
+        seed=int(rec.get("seed", 0)),
+    )
+
+
+def _result_line(r, out_dir: Optional[str]) -> dict:
+    line = {
+        "request_id": r.request_id,
+        "steps": r.steps,
+        "batch_members": r.batch_size,
+        "queue_latency_s": round(r.queue_latency_s, 6),
+        "field_mean": float(np.mean(np.asarray(r.field, np.float64))),
+        "field_max": float(np.max(np.asarray(r.field, np.float64))),
+    }
+    if r.residual_sumsq is not None:
+        line["residual_sumsq"] = r.residual_sumsq
+    if r.snapshots is not None:
+        line["snapshots"] = len(r.snapshots)
+    if out_dir:
+        import os
+
+        path = os.path.join(out_dir, f"req-{r.request_id}.npy")
+        np.save(path, r.field)
+        line["field_path"] = path
+    return line
+
+
+def _smoke_requests() -> List[dict]:
+    # two heterogeneous scenarios in one bucket + one in a second bucket:
+    # exercises packing AND bucket separation in under a second on CPU
+    return [
+        {"grid": 16, "steps": 4, "alpha": 0.5, "bc_value": 1.0, "seed": 1},
+        {"grid": 16, "steps": 6, "alpha": 0.8, "init": "gaussian", "seed": 2},
+        {"grid": 12, "steps": 3, "alpha": 0.3},
+    ]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="heat3d serve",
+        description="batched scenario engine: queue scenario requests, "
+        "pack shape-bucketed batches, stream per-member results "
+        "(docs/SERVING.md)",
+    )
+    p.add_argument("--requests", default=None, metavar="FILE.jsonl",
+                   help="scenario submissions, one JSON object per line")
+    p.add_argument("--smoke", action="store_true",
+                   help="run a built-in tiny multi-bucket batch (CI wiring; "
+                   "CPU-safe, sub-second)")
+    p.add_argument("--bench", action="store_true",
+                   help="measure one ensemble throughput row "
+                   "(batch_shape/members_per_step provenance) and print it")
+    p.add_argument("--members", type=int, default=4,
+                   help="(--bench) ensemble members")
+    p.add_argument("--grid", type=int, default=32,
+                   help="(--bench) grid edge")
+    p.add_argument("--steps", type=int, default=20,
+                   help="(--bench) step floor per trial")
+    p.add_argument("--batch-mesh", type=int, default=1,
+                   help="devices along the batch axis (the mesh factorizes "
+                   "b x space; 1 = all devices spatial)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="members per packed batch cap "
+                   "(default $HEAT3D_SERVE_MAX_BATCH or 64)")
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help="stream per-member field snapshots every K steps "
+                   "(0 = final field only)")
+    p.add_argument("--residuals", action="store_true",
+                   help="report each member's residual sum-of-squares "
+                   "(one extra update per member)")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="save each final field as DIR/req-<id>.npy")
+    p.add_argument("--ledger", default=None,
+                   help="run ledger path (default $HEAT3D_LEDGER)")
+    args = p.parse_args(argv)
+
+    obs.activate(args.ledger, meta={"entry": "serve"})
+    try:
+        rc = _main(args)
+    except (ValueError, TypeError, NotImplementedError, RuntimeError) as e:
+        # TypeError covers malformed request VALUES (e.g. a JSON null
+        # where a number belongs: int(None)) — same clean exit as any
+        # other bad request, not a traceback
+        print(f"heat3d serve: error: {e}", file=sys.stderr)
+        obs.deactivate(rc=2, error=f"{type(e).__name__}: {str(e)[:200]}")
+        return 2
+    except BaseException as e:
+        obs.deactivate(rc=1, error=f"{type(e).__name__}: {str(e)[:200]}")
+        raise
+    obs.export_at_exit()
+    obs.deactivate(rc=rc)
+    return rc
+
+
+def _main(args) -> int:
+    if args.bench:
+        from heat3d_tpu.core.config import GridConfig, SolverConfig
+        from heat3d_tpu.serve.bench import bench_ensemble_throughput
+        from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+
+        base = SolverConfig(
+            grid=GridConfig.cube(args.grid), backend="jnp",
+        )
+        members = [
+            Scenario(alpha=0.3 + 0.4 * (m + 1) / args.members, seed=m)
+            for m in range(args.members)
+        ]
+        row = bench_ensemble_throughput(
+            ScenarioBatch(base, members),
+            steps=args.steps,
+            batch_mesh=args.batch_mesh,
+        )
+        print(json.dumps(row))
+        return 0
+
+    if args.smoke:
+        records = _smoke_requests()
+    elif args.requests:
+        records = []
+        with open(args.requests) as f:
+            for i, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{args.requests}:{i}: unparseable request: {e}"
+                    ) from None
+                if not isinstance(rec, dict):
+                    raise ValueError(
+                        f"{args.requests}:{i}: request must be a JSON object"
+                    )
+                records.append(rec)
+        if not records:
+            raise ValueError(f"{args.requests}: no requests")
+    else:
+        print(
+            "heat3d serve: need --requests FILE, --smoke, or --bench",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.out:
+        import os
+
+        os.makedirs(args.out, exist_ok=True)
+
+    from heat3d_tpu.serve.queue import ScenarioQueue
+
+    queue = ScenarioQueue(
+        max_batch=args.max_batch,
+        batch_mesh=args.batch_mesh,
+        snapshot_every=args.snapshot_every,
+        with_residuals=args.residuals,
+    )
+    for rec in records:
+        queue.submit(_base_from_record(rec), _scenario_from_record(rec))
+    log.info("serve: %d request(s) queued", len(queue))
+    n = 0
+    for r in queue.drain():
+        print(json.dumps(_result_line(r, args.out)), flush=True)
+        n += 1
+    if n != len(records):
+        print(
+            f"heat3d serve: delivered {n} of {len(records)} requests",
+            file=sys.stderr,
+        )
+        return 1
+    log.info("serve: %d result(s) streamed", n)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,335 @@
+"""The request queue: scenario submissions -> shape-bucketed batches ->
+streamed per-member results.
+
+The serving posture (ROADMAP "solver-as-a-service"): many small
+independent requests amortize ONE compiled program per shape bucket
+instead of paying a compile each. ``submit()`` enqueues a scenario;
+``drain()`` packs compatible pending requests (same
+:func:`~heat3d_tpu.serve.scenario.solver_bucket_key`) into batches,
+pads each batch up to a power-of-two member count (so the compiled-
+program cache is hit by ANY request count, not just repeats of one), and
+executes them through cached :class:`~heat3d_tpu.serve.ensemble
+.EnsembleSolver` instances. Results stream back in SUBMISSION order.
+
+Observability: every submission lands a ``serve_submit`` ledger event,
+every executed batch a ``serve_batch_start`` point + a ``serve_batch``
+span, every delivered result a ``serve_result`` event with the
+request's queue latency; the metrics registry carries queue depth,
+batch-size and per-request latency histograms. Knobs:
+``HEAT3D_SERVE_QUEUE`` caps the pending depth (submit raises when
+full), ``HEAT3D_SERVE_MAX_BATCH`` caps members per packed batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from heat3d_tpu import obs
+from heat3d_tpu.core.config import SolverConfig
+from heat3d_tpu.serve.ensemble import EnsembleSolver
+from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch, solver_bucket_key
+from heat3d_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_QUEUE_DEPTH = "HEAT3D_SERVE_QUEUE"
+ENV_MAX_BATCH = "HEAT3D_SERVE_MAX_BATCH"
+DEFAULT_QUEUE_DEPTH = 1024
+DEFAULT_MAX_BATCH = 64
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, ""))
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+def _pad_pow2(n: int, cap: int) -> int:
+    """The bucketed batch size: the next power of two >= n, capped. One
+    compiled program per (shape bucket, padded size) then serves every
+    request count up to the cap."""
+    p = 1
+    while p < n:
+        p *= 2
+    return min(p, cap)
+
+
+def _padded_size(n: int, cap: int, batch_mesh: int) -> int:
+    """The executed batch size for ``n`` live members: pow2-bucketed,
+    then rounded up to a multiple of ``batch_mesh`` — the ensemble
+    shards members across the batch axis, so a padded size the mesh
+    cannot divide would fail EVERY drain of that chunk (the cap may be
+    exceeded by the rounding; padding members cost 0 steps)."""
+    padded = _pad_pow2(n, cap)
+    if padded % batch_mesh:
+        padded = -(-padded // batch_mesh) * batch_mesh
+    return padded
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One request's streamed result."""
+
+    request_id: int
+    field: np.ndarray  # final (nx, ny, nz) member field
+    steps: int
+    residual_sumsq: Optional[float]
+    batch_size: int  # members packed in the executing batch (pre-pad)
+    queue_latency_s: float  # submit -> result delivery
+    snapshots: Optional[List[np.ndarray]] = None  # per snapshot_every chunk
+
+
+@dataclasses.dataclass
+class _Pending:
+    request_id: int
+    base: SolverConfig
+    scenario: Scenario
+    submitted_at: float
+
+
+class ScenarioQueue:
+    """Submit scenarios, drain shape-bucketed batches, stream results.
+
+    Single-controller, synchronous: ``drain()`` (or ``serve_pending()``)
+    executes everything pending and yields results. The compiled-program
+    amortization lives in ``self._solvers`` — an :class:`EnsembleSolver`
+    (traced binding: coefficients are runtime inputs) per
+    (bucket key, padded batch size), reused across drains.
+    """
+
+    def __init__(
+        self,
+        max_batch: Optional[int] = None,
+        max_depth: Optional[int] = None,
+        batch_mesh: int = 1,
+        snapshot_every: int = 0,
+        with_residuals: bool = False,
+    ):
+        self.max_batch = max_batch or _env_int(ENV_MAX_BATCH, DEFAULT_MAX_BATCH)
+        self.max_depth = max_depth or _env_int(
+            ENV_QUEUE_DEPTH, DEFAULT_QUEUE_DEPTH
+        )
+        self.batch_mesh = batch_mesh
+        self.snapshot_every = snapshot_every
+        self.with_residuals = with_residuals
+        self._pending: "OrderedDict[int, _Pending]" = OrderedDict()
+        self._next_id = 0
+        self._solvers: Dict[Tuple, EnsembleSolver] = {}
+        self._depth_gauge = obs.REGISTRY.gauge(
+            "serve_queue_depth", "pending scenario requests"
+        )
+        self._latency_hist = obs.REGISTRY.histogram(
+            "serve_request_latency_seconds",
+            "submit -> result delivery per request",
+        )
+        self._batch_hist = obs.REGISTRY.histogram(
+            "serve_batch_members", "members packed per executed batch"
+        )
+
+    # ---- submission -------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def submit(self, base: SolverConfig, scenario: Scenario) -> int:
+        """Enqueue one scenario over structural config ``base``; returns
+        the request id results are keyed by. Raises when the queue is at
+        ``HEAT3D_SERVE_QUEUE`` depth (backpressure must be explicit — a
+        silently unbounded queue is how a service dies)."""
+        if len(self._pending) >= self.max_depth:
+            raise RuntimeError(
+                f"serve queue full ({self.max_depth} pending; "
+                f"{ENV_QUEUE_DEPTH} raises the cap) — drain before "
+                "submitting more"
+            )
+        if scenario.steps is None:
+            # materialize the budget NOW: num_steps is not part of the
+            # structural bucket key (budgets are traced inputs), so a
+            # default-budget scenario packed with requests from another
+            # base must not silently inherit that base's step count
+            scenario = dataclasses.replace(
+                scenario, steps=base.run.num_steps
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self._pending[rid] = _Pending(
+            request_id=rid,
+            base=base,
+            scenario=scenario,
+            submitted_at=time.monotonic(),
+        )
+        self._depth_gauge.set(len(self._pending))
+        obs.get().event(
+            "serve_submit",
+            request_id=rid,
+            grid=list(base.grid.shape),
+            stencil=base.stencil.kind,
+            steps=scenario.steps,  # materialized above — never None here
+            queue_depth=len(self._pending),
+        )
+        return rid
+
+    # ---- batching ---------------------------------------------------------
+
+    def _buckets(self) -> "OrderedDict[Tuple, List[_Pending]]":
+        out: "OrderedDict[Tuple, List[_Pending]]" = OrderedDict()
+        for p in self._pending.values():
+            out.setdefault(solver_bucket_key(p.base), []).append(p)
+        return out
+
+    def _solver_for(
+        self, batch: ScenarioBatch, padded: int
+    ) -> EnsembleSolver:
+        key = (batch.bucket_key(), padded, self.batch_mesh)
+        solver = self._solvers.get(key)
+        if solver is None:
+            solver = EnsembleSolver(
+                batch, batch_mesh=self.batch_mesh, bind="traced"
+            )
+            self._solvers[key] = solver
+        else:
+            # same structure, new member values: rebind the coefficient
+            # arrays; the compiled programs (keyed on shapes only — the
+            # traced binding's whole point) are reused as-is
+            solver.batch = batch
+            solver._build_coefficients()
+        return solver
+
+    def _pad_batch(
+        self, base: SolverConfig, members: List[Scenario], padded: int
+    ) -> ScenarioBatch:
+        fill = padded - len(members)
+        if fill > 0:
+            # dummy members run 0 steps (masked out after the first
+            # bound computation) and are never delivered
+            members = members + [
+                dataclasses.replace(members[0], steps=0) for _ in range(fill)
+            ]
+        return ScenarioBatch(base, members)
+
+    # ---- execution --------------------------------------------------------
+
+    def drain(self) -> Iterator[ServeResult]:
+        """Execute everything pending, yielding results in SUBMISSION
+        order (requests are only delivered once every batch of this drain
+        has executed — ordering beats latency at this layer; callers that
+        want per-batch streaming use :meth:`serve_batches`)."""
+        results: Dict[int, ServeResult] = {}
+        order = list(self._pending.keys())
+        err: Optional[BaseException] = None
+        try:
+            for batch_results in self.serve_batches():
+                for r in batch_results:
+                    results[r.request_id] = r
+        except Exception as e:  # noqa: BLE001 - deliver, then surface
+            # one bucket failing (e.g. its config can't build) must not
+            # destroy the batches that already executed: stream what
+            # landed, then re-raise. The failed bucket's requests are
+            # still pending (they pop only on successful execution), so
+            # a caller can fix the config and drain again.
+            err = e
+        for rid in order:
+            if rid in results:
+                yield results[rid]
+        if err is not None:
+            raise err
+
+    def serve_batches(self) -> Iterator[List[ServeResult]]:
+        """Pack and execute pending requests bucket by bucket, yielding
+        each executed batch's results as they land."""
+        for bucket_key_, group in self._buckets().items():
+            while group:
+                chunk = group[: self.max_batch]
+                group = group[len(chunk):]
+                yield self._execute(chunk)
+
+    def _execute(self, chunk: List[_Pending]) -> List[ServeResult]:
+        base = chunk[0].base
+        members = [p.scenario for p in chunk]
+        padded = _padded_size(len(members), self.max_batch, self.batch_mesh)
+        batch = self._pad_batch(base, members, padded)
+        solver = self._solver_for(batch, padded)
+        self._batch_hist.observe(len(chunk))
+        obs.get().event(
+            "serve_batch_start",
+            members=len(chunk),
+            padded=padded,
+            request_ids=[p.request_id for p in chunk],
+            bucket=str(batch.bucket_key()),
+            mesh=list(solver.cfg.mesh.shape),
+            batch_mesh=solver.batch_mesh,
+            time_blocking=solver.cfg.time_blocking,
+        )
+        budgets = np.asarray(
+            [batch.member_steps(m) for m in range(len(batch))], np.int32
+        )
+        with obs.get().span(
+            "serve_batch", members=len(chunk), padded=padded
+        ) as span:
+            u = solver.init_state()
+            snapshots: Optional[List[np.ndarray]] = None
+            if self.snapshot_every > 0:
+                snapshots = []
+                done = np.zeros_like(budgets)
+                while (done < budgets).any():
+                    stride = np.minimum(
+                        budgets - done, self.snapshot_every
+                    ).astype(np.int32)
+                    u = solver.run(u, stride)
+                    done = done + stride
+                    snapshots.append(solver.gather(u))
+            else:
+                u = solver.run(u, budgets)
+            # the last snapshot already gathered the final state — don't
+            # pay a second full-batch device-to-host transfer for it
+            fields = snapshots[-1] if snapshots else solver.gather(u)
+            residuals = None
+            if self.with_residuals:
+                # the residual costs one probe update per member — a
+                # health signal measured FROM the delivered state. Fields
+                # are gathered first (the probe donates u), so delivered
+                # results stay at exactly the budgeted step either way.
+                u, r2 = solver.step_with_member_residuals(u)
+                residuals = np.asarray(r2)
+            span.add(steps_total=int(budgets.sum()))
+
+        out: List[ServeResult] = []
+        now = time.monotonic()
+        for i, p in enumerate(chunk):
+            self._pending.pop(p.request_id, None)
+            latency = now - p.submitted_at
+            self._latency_hist.observe(latency)
+            obs.get().event(
+                "serve_result",
+                request_id=p.request_id,
+                steps=int(budgets[i]),
+                batch_members=len(chunk),
+                queue_latency_s=round(latency, 6),
+            )
+            out.append(
+                ServeResult(
+                    request_id=p.request_id,
+                    field=fields[i],
+                    steps=int(budgets[i]),
+                    residual_sumsq=(
+                        float(residuals[i]) if residuals is not None else None
+                    ),
+                    batch_size=len(chunk),
+                    queue_latency_s=latency,
+                    snapshots=(
+                        [s[i] for s in snapshots]
+                        if snapshots is not None
+                        else None
+                    ),
+                )
+            )
+        self._depth_gauge.set(len(self._pending))
+        return out

@@ -1,0 +1,168 @@
+"""Scenario specs: what varies per ensemble member, what must be shared.
+
+A *scenario* is one independent heat problem: its initial condition, its
+Dirichlet boundary value, its diffusivity/timestep, and its step budget.
+A :class:`ScenarioBatch` packs B scenarios over ONE structural config —
+grid, stencil kind, BC kind, mesh, precision, solver knobs — which is
+exactly the set a single compiled SPMD program can serve with the
+per-member values as runtime inputs (serve/ensemble.py). The queue
+(serve/queue.py) buckets incoming requests by :meth:`ScenarioBatch
+.bucket_key` so only compatible scenarios ever share a program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from heat3d_tpu.core.config import SolverConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One ensemble member's independent problem data.
+
+    ``init`` — named initializer (core.golden.INITIALIZERS) or an explicit
+    array of the TRUE grid shape. ``alpha``/``dt`` — the member's
+    diffusivity and timestep (``dt=None`` = 0.9x the member's stable dt,
+    same rule as GridConfig). ``bc_value`` — the member's Dirichlet
+    boundary value (ignored under periodic BCs). ``steps`` — the member's
+    step budget (``None`` = the batch default); members of one batch may
+    carry different budgets — finished members freeze bitwise while the
+    rest run on.
+    """
+
+    init: Union[str, np.ndarray] = "hot-cube"
+    alpha: float = 1.0
+    dt: Optional[float] = None
+    bc_value: float = 0.0
+    steps: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.alpha <= 0.0:
+            raise ValueError(
+                f"scenario alpha must be > 0, got {self.alpha} (alpha*dt=0 "
+                "degenerates the tap footprint the batch shares)"
+            )
+        if self.dt is not None and self.dt <= 0.0:
+            raise ValueError(f"scenario dt must be > 0, got {self.dt}")
+        if self.steps is not None and self.steps < 0:
+            raise ValueError(f"scenario steps must be >= 0, got {self.steps}")
+
+
+class ScenarioBatch:
+    """B scenarios over one structural :class:`SolverConfig`.
+
+    ``base`` supplies everything the members share: grid shape/spacing,
+    stencil kind + BC kind, mesh, precision, and the solver knobs
+    (backend/halo/time_blocking/...). Each member's ``alpha``/``dt``/
+    ``bc_value``/``steps`` override the base's per-member. Construction
+    validates that every member's update taps occupy the SAME footprint
+    as the base's (they always do for alpha*dt > 0 — the guard exists so
+    a degenerate member fails loudly instead of silently changing the
+    shared chain structure).
+    """
+
+    def __init__(self, base: SolverConfig, members: Sequence[Scenario]):
+        members = tuple(members)
+        if not members:
+            raise ValueError("a ScenarioBatch needs at least one scenario")
+        self.base = base
+        self.members = members
+        self._check_footprints()
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+    # ---- per-member config materialization --------------------------------
+
+    def member_dt(self, i: int) -> float:
+        m = self.members[i]
+        if m.dt is not None:
+            return m.dt
+        g = dataclasses.replace(self.base.grid, alpha=m.alpha, dt=None)
+        return g.effective_dt()
+
+    def member_config(self, i: int) -> SolverConfig:
+        """The full solo :class:`SolverConfig` member ``i`` describes —
+        what a single-tenant :class:`HeatSolver3D` run of this scenario
+        would be configured with (the bitwise reference the ensemble
+        equivalence tests compare against)."""
+        m = self.members[i]
+        return dataclasses.replace(
+            self.base,
+            grid=dataclasses.replace(
+                self.base.grid, alpha=m.alpha, dt=self.member_dt(i)
+            ),
+            stencil=dataclasses.replace(
+                self.base.stencil, bc_value=m.bc_value
+            ),
+            run=dataclasses.replace(
+                self.base.run, num_steps=self.member_steps(i), seed=m.seed
+            ),
+        )
+
+    def member_steps(self, i: int) -> int:
+        m = self.members[i]
+        return self.base.run.num_steps if m.steps is None else m.steps
+
+    def member_taps(self, i: int) -> np.ndarray:
+        from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+
+        return stencil_taps(
+            STENCILS[self.base.stencil.kind],
+            self.members[i].alpha,
+            self.member_dt(i),
+            self.base.grid.spacing,
+        )
+
+    def _check_footprints(self) -> None:
+        from heat3d_tpu.core.stencils import flat_taps
+        from heat3d_tpu.parallel.step import _solver_taps
+
+        want = tuple(
+            (di, dj, dk) for di, dj, dk, _ in flat_taps(_solver_taps(self.base))
+        )
+        for i in range(len(self.members)):
+            got = tuple(
+                (di, dj, dk) for di, dj, dk, _ in flat_taps(self.member_taps(i))
+            )
+            if got != want:
+                raise ValueError(
+                    f"scenario {i}: its taps occupy footprint {got} but the "
+                    f"batch's shared structure is {want} — members of one "
+                    "batch must share the stencil footprint (alpha*dt > 0)"
+                )
+
+    # ---- queue bucketing ---------------------------------------------------
+
+    def bucket_key(self) -> Tuple:
+        """The structural compatibility key: scenarios whose batches share
+        this key can be packed into ONE compiled ensemble program (the
+        per-member values are runtime inputs; step budgets are traced, so
+        they do NOT bucket)."""
+        return solver_bucket_key(self.base)
+
+
+def solver_bucket_key(cfg: SolverConfig) -> Tuple:
+    """The structural key of ``cfg``: everything that shapes the compiled
+    ensemble program. Two requests sharing this key differ only in
+    runtime inputs (IC, bc value, taps, budget)."""
+    return (
+        tuple(cfg.grid.shape),
+        tuple(cfg.grid.spacing),
+        cfg.stencil.kind,
+        cfg.stencil.bc.value,
+        tuple(cfg.mesh.shape),
+        cfg.precision.storage,
+        cfg.precision.compute,
+        cfg.precision.residual,
+        cfg.backend,
+        cfg.halo,
+        cfg.halo_order,
+        cfg.overlap,
+        cfg.time_blocking,
+    )

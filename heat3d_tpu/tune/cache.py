@@ -109,26 +109,34 @@ def _grid_bucket(cfg: SolverConfig) -> int:
     return round(math.log2(cells))
 
 
-def cache_key(cfg: SolverConfig) -> str:
+def cache_key(cfg: SolverConfig, batch_size: int = 1) -> str:
     """The lookup key for ``cfg`` in the CURRENT environment (chip
     generation and process count are read live — the same config keys
-    differently on different hardware, by design)."""
+    differently on different hardware, by design).
+
+    ``batch_size`` > 1 appends a batch-shape bucket (``b2^<round(log2
+    B)>``) — the ensemble engine's workload axis (serve/ensemble): a
+    winner measured for one solo run must not steer a 64-member packed
+    batch (whose per-chip working set and halo:compute ratio differ), and
+    vice versa. Solo keys stay byte-identical to the pre-batch format so
+    every committed cache entry remains addressable."""
     try:
         import jax
 
         procs = int(jax.process_count())
     except Exception:  # noqa: BLE001
         procs = 1
-    return "|".join(
-        (
-            chip_generation(),
-            f"p{procs}",
-            f"d{cfg.mesh.num_devices}",
-            f"g2^{_grid_bucket(cfg)}",
-            cfg.stencil.kind,
-            cfg.precision.storage,
-        )
-    )
+    parts = [
+        chip_generation(),
+        f"p{procs}",
+        f"d{cfg.mesh.num_devices}",
+        f"g2^{_grid_bucket(cfg)}",
+        cfg.stencil.kind,
+        cfg.precision.storage,
+    ]
+    if batch_size > 1:
+        parts.append(f"b2^{round(math.log2(batch_size))}")
+    return "|".join(parts)
 
 
 def config_knobs(cfg: SolverConfig) -> Dict[str, Any]:
@@ -370,7 +378,7 @@ def _auto_knobs(cfg: SolverConfig) -> List[str]:
 
 
 def resolve_config(
-    cfg: SolverConfig, path: Optional[str] = None
+    cfg: SolverConfig, path: Optional[str] = None, batch_size: int = 1
 ) -> SolverConfig:
     """Resolve ``cfg``'s auto knobs through the tuning cache.
 
@@ -379,12 +387,14 @@ def resolve_config(
     record the outcome (``tune_cache_hit`` with the applied knobs,
     ``tune_cache_miss``, or ``tune_cache_stale`` with the reason). Any
     failure — unreadable store, stale entry, cached knob invalid in this
-    env — falls back to :func:`_static_fallback`. Never raises."""
+    env — falls back to :func:`_static_fallback`. Never raises.
+    ``batch_size`` routes ensemble workloads (serve/ensemble) to their
+    own batch-shape-bucketed entries — see :func:`cache_key`."""
     try:
         autos = _auto_knobs(cfg)
         if not autos or os.environ.get(ENV_DISABLE):
             return _static_fallback(cfg)
-        return _resolve(cfg, autos, path)
+        return _resolve(cfg, autos, path, batch_size=batch_size)
     except Exception:  # noqa: BLE001 - resolution must never kill a run
         try:
             return _static_fallback(cfg)
@@ -431,10 +441,13 @@ def _resolved_invalid(resolved: SolverConfig) -> Optional[str]:
 
 
 def _resolve(
-    cfg: SolverConfig, autos: List[str], path: Optional[str]
+    cfg: SolverConfig,
+    autos: List[str],
+    path: Optional[str],
+    batch_size: int = 1,
 ) -> SolverConfig:
     p = cache_path(path)
-    key = cache_key(cfg)
+    key = cache_key(cfg, batch_size=batch_size)
     entry = (load(p).get("entries") or {}).get(key)
     if not isinstance(entry, dict):
         _event_once(

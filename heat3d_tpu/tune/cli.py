@@ -53,11 +53,19 @@ def _base_config(args):
         mesh = MeshConfig(shape=tuple(args.mesh))
     else:
         raise SystemExit("--mesh takes 1 or 3 ints")
+    import dataclasses
+
+    prec = Precision.bf16() if args.dtype == "bf16" else Precision.fp32()
+    cd = getattr(args, "compute_dtype", None)
+    if cd:
+        prec = dataclasses.replace(
+            prec, compute="bfloat16" if cd == "bf16" else "float32"
+        )
     return SolverConfig(
         grid=GridConfig(shape=grid),
         stencil=StencilConfig(kind=args.stencil),
         mesh=mesh,
-        precision=Precision.bf16() if args.dtype == "bf16" else Precision.fp32(),
+        precision=prec,
         run=RunConfig(num_steps=getattr(args, "steps", 100)),
         # the search's static reference: the pre-tuner defaults
         backend="auto",
@@ -123,10 +131,19 @@ def cmd_run(args) -> int:
         obs.deactivate(rc=1, error=f"{type(e).__name__}: {str(e)[:200]}")
         raise
     if args.json:
+        # the measurement-session driver gates sweep rows on this field:
+        # a silently-CPU-fallback search must not retire a chip row
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:  # noqa: BLE001
+            platform = "unknown"
         print(
             json.dumps(
                 {
                     "key": result.key,
+                    "platform": platform,
                     "elapsed_s": result.elapsed_s,
                     "budget_s": result.budget_s,
                     "winner": (
@@ -309,6 +326,10 @@ def _add_context_args(p) -> None:
                    help="global grid: one int (cube) or three")
     p.add_argument("--stencil", choices=["7pt", "27pt"], default="7pt")
     p.add_argument("--dtype", choices=["fp32", "bf16"], default="fp32")
+    p.add_argument("--compute-dtype", choices=["fp32", "bf16"], default=None,
+                   help="stencil-math dtype override (default: the "
+                   "storage policy's — fp32 either way); the measurement "
+                   "sessions' storage/compute A/B grid rides this")
     p.add_argument("--mesh", type=int, nargs="+", default=None,
                    help="device mesh Px Py Pz (default: all devices, "
                    "balanced 3D)")

@@ -269,3 +269,15 @@ if [[ -z "${SKIP_TUNE_SMOKE:-}" ]]; then
     >> "$SUITE_LOG" 2>&1 \
     || note "suite: tune cache-schema lint failed (rc=$?) — informational"
 fi
+
+# Serve smoke (informational, beside the tune smoke): the built-in tiny
+# multi-bucket batch through the batched scenario engine — submit ->
+# shape-bucketed packing -> streamed results, CPU-safe and sub-minute —
+# so the serving path (docs/SERVING.md) can't rot between serving
+# sessions. Fails SOFT; SKIP_SERVE_SMOKE=1 skips.
+if [[ -z "${SKIP_SERVE_SMOKE:-}" ]]; then
+  python -m heat3d_tpu.cli serve --smoke >> "$SUITE_LOG" 2>&1 \
+    || note "suite: serve smoke failed (rc=$?) — informational"
+else
+  note "suite: serve smoke skipped (SKIP_SERVE_SMOKE=1)"
+fi

@@ -215,40 +215,54 @@ echo "--- stage 3: bench suite" | tee -a "$LOG"
 timeout -k 30 "${SUITE_TIMEOUT:-7200}" bash scripts/run_bench_suite.sh \
   bench_results.jsonl 2>&1 | tail -3 | tee -a "$LOG"
 
-echo "--- stage 3b: direct/exchange/conv A/B (512^3 fp32 tb=1)" | tee -a "$LOG"
+# Stages 3b-3f ride the TUNER (ROADMAP carry-over, retired this PR):
+# each A/B is one `tune run --no-cache-write --json` invocation. The
+# trial table IS the A/B record — per-trial tune_trial ledger events,
+# full bench-row provenance (sync_rtt_s, rtt_dominated exclusion), and
+# the JSON `decisions` field carries the per-knob pairwise verdicts, so
+# ab_decide's log scraping is no longer needed for these stages.
+# --no-cache-write: a measurement session records evidence; flipping the
+# operator cache stays an explicit `tune run` (no --no-cache-write) or
+# `tune apply`. Env-knob arms (HEAT3D_FACTOR_Y / HEAT3D_MEHRSTELLEN /
+# HEAT3D_FACTOR_7PT) wrap the invocation: the tuner searches the config
+# knobs, the env prefix selects the code-path arm, and the A/B across
+# arms is the two JSON lines' winners side by side in $LOG.
+tune_ab() {  # tune_ab KEY DESC [VAR=V ...] -- TUNE_RUN_ARGS...
+  local key="$1" desc="$2"; shift 2
+  local envp=()
+  while [[ $# -gt 0 && $1 != "--" ]]; do envp+=("$1"); shift; done
+  shift  # the --
+  row_done "$key" && { echo "$desc: already landed (state)" | tee -a "$LOG"; return 0; }
+  wait_tpu "$desc" || return 1
+  local out
+  out=$(env ${envp[@]+"${envp[@]}"} timeout -k 30 "${TUNE_AB_TIMEOUT:-1800}" \
+    python -m heat3d_tpu.cli tune run --no-cache-write --json \
+    --steps 50 --repeats 2 "$@" 2>>"$LOG" | tail -1)
+  echo "$desc: $out" | tee -a "$LOG"
+  row_landed "$out" && row_mark "$key"
+}
+
+echo "--- stage 3b: route A/B via tuner (512^3 fp32 tb=1: auto/pallas/jnp/conv + exchange arm)" | tee -a "$LOG"
 # conv = one XLA conv_general_dilated (MXU) — the obvious XLA-native
 # implementation, measured so the kernels' advantage is a committed number
-for mode in direct exchange conv; do
-  env_prefix=()
-  extra=()
-  [[ $mode == exchange ]] && env_prefix=(env HEAT3D_NO_DIRECT=1)
-  [[ $mode == conv ]] && extra=(--backend conv)
-  row_done "3b:$mode" && { echo "$mode: already landed (state)" | tee -a "$LOG"; continue; }
-  wait_tpu "A/B $mode" || continue
-  out=$("${env_prefix[@]}" timeout -k 30 1200 python -m heat3d_tpu.bench \
-    --grid 512 --steps 50 --mesh 1 1 1 "${extra[@]}" --bench throughput \
-    2>>"$LOG" | tail -1)
-  echo "$mode: $out" | tee -a "$LOG"
-  row_landed "$out" && row_mark "3b:$mode"
-done
+tune_ab "3b:routes" "route A/B" -- \
+  --grid 512 --mesh 1 1 1 --knob backend=pallas,jnp,conv
+# the exchange arm: HEAT3D_NO_DIRECT=1 disables the direct kernel routes,
+# so backend=pallas here measures the exchange-path streaming kernel —
+# the old stage's direct-vs-exchange comparison, kept as its own row
+tune_ab "3b:exchange" "route A/B (exchange arm)" HEAT3D_NO_DIRECT=1 -- \
+  --grid 512 --mesh 1 1 1 --knob backend=pallas,jnp
 
 # The factored-default 27pt and bf16-compute rows are already in the
 # suite record (stage 3); these A/B stages log the counterfactual sides.
-echo "--- stage 3c: 27pt y-factoring A/B (512^3 fp32)" | tee -a "$LOG"
+echo "--- stage 3c: 27pt y-factoring A/B via tuner (512^3 fp32, tb searched)" | tee -a "$LOG"
 [[ -n $SKIP_FY_AB ]] && echo "skipped: y-factored probe failed" | tee -a "$LOG"
 for fy in $([[ -z $SKIP_FY_AB ]] && echo 1 0); do
-  for tb in 1 2; do
-    row_done "3c:fy=$fy:tb=$tb" && { echo "factor_y=$fy tb=$tb: already landed (state)" | tee -a "$LOG"; continue; }
-    wait_tpu "27pt A/B fy=$fy tb=$tb" || continue
-    out=$(env HEAT3D_FACTOR_Y=$fy timeout -k 30 1200 python -m heat3d_tpu.bench \
-      --grid 512 --steps 50 --stencil 27pt --time-blocking $tb \
-      --mesh 1 1 1 --bench throughput 2>>"$LOG" | tail -1)
-    echo "factor_y=$fy tb=$tb: $out" | tee -a "$LOG"
-    row_landed "$out" && row_mark "3c:fy=$fy:tb=$tb"
-  done
+  tune_ab "3c:fy=$fy" "factor_y=$fy" HEAT3D_FACTOR_Y=$fy -- \
+    --grid 512 --stencil 27pt --mesh 1 1 1 --knob time_blocking=1,2
 done
 
-echo "--- stage 3d: bf16-compute A/B (1024^3 tb=2)" | tee -a "$LOG"
+echo "--- stage 3d: bf16-compute A/B via tuner (1024^3, tb 1 vs 2)" | tee -a "$LOG"
 # storage/compute grid: bf16/fp32 vs bf16/bf16 answers whether the bf16
 # tb=2 ceiling gap is VPU-width-bound; fp32/bf16 runs the same width A/B
 # on the fp32 traffic shape (accuracy gates: tests/test_solver.py bf16
@@ -258,46 +272,33 @@ bf16_modes=("bf16 fp32" "bf16 bf16" "fp32 bf16")
   echo "skipped: bf16-compute probe failed" | tee -a "$LOG"; }
 for dt in ${bf16_modes[@]+"${bf16_modes[@]}"}; do
   read -r st cd <<<"$dt"
-  row_done "3d:$st/$cd" && { echo "storage=$st compute=$cd: already landed (state)" | tee -a "$LOG"; continue; }
-  wait_tpu "compute A/B $st/$cd" || continue
-  out=$(timeout -k 30 1200 python -m heat3d_tpu.bench --grid 1024 --steps 50 \
-    --dtype $st --compute-dtype $cd --time-blocking 2 --mesh 1 1 1 \
-    --bench throughput 2>>"$LOG" | tail -1)
-  echo "storage=$st compute=$cd: $out" | tee -a "$LOG"
-  row_landed "$out" && row_mark "3d:$st/$cd"
+  tune_ab "3d:$st/$cd" "storage=$st compute=$cd" -- \
+    --grid 1024 --dtype $st --compute-dtype $cd --mesh 1 1 1 \
+    --knob time_blocking=2
 done
 
-echo "--- stage 3e: 27pt mehrstellen A/B (512^3 fp32, tb=1 and tb=2)" | tee -a "$LOG"
+echo "--- stage 3e: 27pt mehrstellen A/B via tuner (512^3 fp32, tb searched)" | tee -a "$LOG"
 # separable S+F route (q-ring direct kernels) vs the factored tap chain;
-# chain_ops/mehrstellen_route in each row pin which route ran
+# chain_ops/mehrstellen_route in each trial row pin which route ran
 [[ -n $SKIP_MEHRSTELLEN ]] && echo "skipped: mehrstellen probe failed" | tee -a "$LOG"
 for mh in $([[ -z $SKIP_MEHRSTELLEN ]] && echo 0 1); do
-  for tb in 1 2; do
-    row_done "3e:mh=$mh:tb=$tb" && { echo "mehrstellen=$mh tb=$tb: already landed (state)" | tee -a "$LOG"; continue; }
-    wait_tpu "mehrstellen A/B mh=$mh tb=$tb" || continue
-    out=$(env HEAT3D_MEHRSTELLEN=$mh timeout -k 30 1200 python -m heat3d_tpu.bench \
-      --grid 512 --steps 50 --stencil 27pt --time-blocking $tb \
-      --mesh 1 1 1 --bench throughput 2>>"$LOG" | tail -1)
-    echo "mehrstellen=$mh tb=$tb: $out" | tee -a "$LOG"
-    row_landed "$out" && row_mark "3e:mh=$mh:tb=$tb"
-  done
+  tune_ab "3e:mh=$mh" "mehrstellen=$mh" HEAT3D_MEHRSTELLEN=$mh -- \
+    --grid 512 --stencil 27pt --mesh 1 1 1 --knob time_blocking=1,2
 done
 
-echo "--- stage 3f: 7pt x-factoring A/B (1024^3 fp32 tb=2 — the headline)" | tee -a "$LOG"
+echo "--- stage 3f: 7pt x-factoring A/B via tuner (1024^3 fp32 tb=2 — the headline)" | tee -a "$LOG"
 # HEAT3D_FACTOR_7PT=1 trades the headline chain's two x-shifted plane
 # reads for one unshifted add on the plane sum; if it wins, the headline
 # default flips next session (the committed record runs factor=0)
 for f7 in 0 1; do
-  row_done "3f:f7=$f7" && { echo "factor_7pt=$f7: already landed (state)" | tee -a "$LOG"; continue; }
-  wait_tpu "7pt-factor A/B $f7" || continue
-  out=$(env HEAT3D_FACTOR_7PT=$f7 timeout -k 30 1500 python -m heat3d_tpu.bench \
-    --grid 1024 --steps 50 --time-blocking 2 --mesh 1 1 1 \
-    --bench throughput 2>>"$LOG" | tail -1)
-  echo "factor_7pt=$f7: $out" | tee -a "$LOG"
-  row_landed "$out" && row_mark "3f:f7=$f7"
+  tune_ab "3f:f7=$f7" "factor_7pt=$f7" HEAT3D_FACTOR_7PT=$f7 -- \
+    --grid 1024 --mesh 1 1 1 --knob time_blocking=2
 done
 
 echo "--- stage 3g: K-cadence convergence A/B (512^3 tb=2, 400 capped steps)" | tee -a "$LOG"
+# NOT a tuner invocation: residual-sync cadence is a converge-loop
+# behavior (the tuner's metric is bench throughput, which never syncs
+# mid-loop) — the A/B must drive the real `heat3d` stepping loop.
 # Measures what residual-sync cadence costs (SURVEY §3.3: syncing every
 # step serializes the pipeline): identical 400-step converge runs under an
 # unreachable tol, checking every step vs every 9 (K-1 = 8 updates = 4

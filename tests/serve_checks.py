@@ -1,0 +1,130 @@
+"""The 4-device CPU-mesh ensemble acceptance battery (run by
+tests/test_serve.py in a subprocess with
+--xla_force_host_platform_device_count=4).
+
+For 7pt and 27pt at tb in {1, 2}, over B=3 heterogeneous scenarios
+(distinct ICs, boundary values, diffusivities, and step budgets) on the
+REAL (4,1,1) spatial mesh:
+
+1. ``bind='baked'`` == 3 independent :class:`HeatSolver3D` runs,
+   BITWISE (each member runs the exact solo executable);
+2. ``bind='traced'`` (the vmapped serving program) is member-wise
+   bitwise-INVARIANT to packing — the B=3 batch equals three B=1 runs
+   of the same parametric program — and matches the solo runs to
+   final-ulp (constant-vs-parameter codegen may contract FMAs
+   differently; docs/SERVING.md "Bitwise contract");
+3. the hybrid mesh factorization b x space (2 x (2,1,1)) over the same
+   4 devices reproduces the pure-spatial traced results bitwise for an
+   even batch.
+"""
+
+import numpy as np
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    Precision,
+    RunConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.models.heat3d import HeatSolver3D
+from heat3d_tpu.serve.ensemble import EnsembleSolver
+from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+
+
+def base_cfg(kind, tb, mesh=(4, 1, 1)):
+    return SolverConfig(
+        grid=GridConfig.cube(16),
+        stencil=StencilConfig(kind=kind, bc=BoundaryCondition.DIRICHLET),
+        mesh=MeshConfig(shape=mesh),
+        precision=Precision.fp32(),
+        run=RunConfig(num_steps=6),
+        backend="jnp",
+        halo="ppermute",
+        time_blocking=tb,
+    )
+
+
+MEMBERS = [
+    Scenario(init="hot-cube", alpha=0.3, bc_value=1.0, steps=6, seed=1),
+    Scenario(init="gaussian", alpha=0.8, bc_value=0.0, steps=5, seed=2),
+    Scenario(init="random", alpha=0.5, bc_value=-0.5, steps=4, seed=3),
+]
+
+
+def check_combo(kind, tb):
+    batch = ScenarioBatch(base_cfg(kind, tb), MEMBERS)
+
+    solo_fields = []
+    for m, sc in enumerate(MEMBERS):
+        solver = HeatSolver3D(batch.member_config(m))
+        u = solver.run(solver.init_state(sc.init), batch.member_steps(m))
+        solo_fields.append(solver.gather(u))
+
+    # 1. baked binding: bitwise-identical to the independent solo runs
+    es = EnsembleSolver(batch, bind="baked")
+    baked = es.gather(es.run(es.init_state()))
+    for m in range(len(MEMBERS)):
+        np.testing.assert_array_equal(
+            baked[m], solo_fields[m],
+            err_msg=f"{kind} tb={tb} member {m}: baked != solo (bitwise)",
+        )
+
+    # 2. traced binding: packing-invariant bitwise, final-ulp vs solo
+    es_t = EnsembleSolver(batch, bind="traced")
+    traced = es_t.gather(es_t.run(es_t.init_state()))
+    for m, sc in enumerate(MEMBERS):
+        b1 = EnsembleSolver(
+            ScenarioBatch(base_cfg(kind, tb), [sc]), bind="traced"
+        )
+        one = b1.gather(b1.run(b1.init_state()))[0]
+        np.testing.assert_array_equal(
+            traced[m], one,
+            err_msg=f"{kind} tb={tb} member {m}: B=3 != B=1 (packing)",
+        )
+        np.testing.assert_allclose(
+            traced[m], solo_fields[m], rtol=2e-6, atol=5e-7,
+            err_msg=f"{kind} tb={tb} member {m}: traced far from solo",
+        )
+    print(f"{kind} tb={tb}: baked bitwise + traced packing-invariant OK")
+
+
+def check_hybrid_mesh():
+    """b x space factorization: 4 members over mesh b=2 x (2,1,1) must
+    reproduce the pure-spatial traced run member-wise bitwise (members
+    are independent; where they live cannot change their math)."""
+    members = MEMBERS + [Scenario(init="hot-cube", alpha=0.6, steps=3, seed=4)]
+    spatial = EnsembleSolver(
+        ScenarioBatch(base_cfg("7pt", 1), members), bind="traced"
+    )
+    want = spatial.gather(spatial.run(spatial.init_state()))
+    hybrid = EnsembleSolver(
+        ScenarioBatch(base_cfg("7pt", 1, mesh=(2, 1, 1)), members),
+        batch_mesh=2,
+        bind="traced",
+    )
+    got = hybrid.gather(hybrid.run(hybrid.init_state()))
+    for m in range(len(members)):
+        np.testing.assert_array_equal(
+            got[m], want[m],
+            err_msg=f"hybrid mesh member {m}: b=2 x (2,1,1) != 1 x (4,1,1)",
+        )
+    print("hybrid b=2 x (2,1,1) == spatial (4,1,1): OK")
+
+
+def main():
+    import jax
+
+    ndev = len(jax.devices())
+    assert ndev == 4, f"need a 4-device CPU mesh, got {ndev}"
+    for kind in ("7pt", "27pt"):
+        for tb in (1, 2):
+            check_combo(kind, tb)
+    check_hybrid_mesh()
+    print("ENSEMBLE EQUIVALENCE OK")
+
+
+if __name__ == "__main__":
+    main()
