@@ -101,6 +101,15 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                   "desc": "XLA cost_analysis of the step executable"},
     "peak_calibrated": {"kind": "point", "module": "obs/perf/roofline.py",
                         "desc": "measured per-chip VPU peak stored"},
+    "obs_anomaly": {"kind": "point", "module": "obs/perf/timeline.py",
+                    "desc": "step-time drift or host straggler flagged "
+                            "(kind_, delta_pct, regress bands)"},
+    "timeline_export": {"kind": "point", "module": "obs/perf/timeline.py",
+                        "desc": "Chrome-trace export written (path, "
+                                "events, streams)"},
+    "slo_verdict": {"kind": "point", "module": "obs/perf/slo.py",
+                    "desc": "SLO evaluation: verdict + per-objective "
+                            "burn rates"},
     # autotuning
     "tune_search_start": {"kind": "point", "module": "tune/measure.py",
                           "desc": "search opened: space, budget, key"},
@@ -130,6 +139,10 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                     "desc": "one packed batch's execution bracket"},
     "serve_result": {"kind": "point", "module": "serve/queue.py",
                      "desc": "one request delivered (queue latency)"},
+    "serve_metrics_summary": {"kind": "point", "module": "serve/queue.py",
+                              "desc": "drain-final per-bucket latency "
+                                      "p50/p95/max + depth high-water "
+                                      "mark (the SLO layer's source)"},
 }
 
 # Wrapper functions whose first argument is an event name (the taxonomy
@@ -220,6 +233,12 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
     "HEAT3D_SERVE_MAX_BATCH": {"module": "serve/queue.py",
                                "desc": "members per packed batch cap "
                                        "(default 64)"},
+    "HEAT3D_SLO_SPEC": {"module": "obs/perf/slo.py",
+                        "desc": "SLO objective-spec path (obs slo / "
+                                "serve --slo default)"},
+    "HEAT3D_SLO_WARN_RATIO": {"module": "obs/perf/slo.py",
+                              "desc": "warn at this fraction of an SLO "
+                                      "ceiling (default 0.9)"},
 }
 
 

@@ -9,6 +9,8 @@ Subcommands::
     heat3d obs roofline [...]                  # achieved-vs-peak (obs/perf/roofline)
     heat3d obs regress RESULTS [...]           # perf-regression gate (obs/perf/regress)
     heat3d obs merge LEDGERS... [...]          # multihost timeline join (obs/perf/merge)
+    heat3d obs timeline LEDGERS... [...]       # Chrome-trace export + drift/stragglers (obs/perf/timeline)
+    heat3d obs slo LEDGER [...]                # SLO burn-rate verdict (obs/perf/slo)
 
 ``summary`` is the operator's post-mortem view: for each run segment in
 the ledger it prints the invocation, a span-duration table (count, total,
@@ -57,6 +59,10 @@ NOTABLE = (
     "serve_submit",
     "serve_batch_start",
     "serve_result",
+    "serve_metrics_summary",
+    "obs_anomaly",
+    "slo_verdict",
+    "timeline_export",
     "run_end",
     "ledger_close",
 )
@@ -333,6 +339,23 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
     for line in ensemble_lines(events):
         print(line, file=out)
 
+    # drift/straggler section: rolling-baseline step-time anomalies
+    # (obs/perf/timeline.detect_anomalies — regress's tolerance bands);
+    # fails soft like every other summary section
+    try:
+        from heat3d_tpu.obs.perf.timeline import (
+            detect_anomalies,
+            format_anomaly,
+        )
+
+        anomalies = detect_anomalies(events)
+        for a in anomalies[:8]:
+            print(f"   {format_anomaly(a)}", file=out)
+        if len(anomalies) > 8:
+            print(f"   ... ({len(anomalies) - 8} more anomalies)", file=out)
+    except Exception:  # noqa: BLE001 - a summary section must not kill summary
+        pass
+
     # timeline of notable events
     shown = 0
     for r in events:
@@ -349,6 +372,8 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
                 "vector_gflops",
                 "request_id", "members", "padded", "queue_depth",
                 "batch_members", "queue_latency_s",
+                "verdict", "depth_max", "delivered", "batches",
+                "span", "delta_pct", "events", "streams",
             )
             if k in r
         ]
@@ -419,7 +444,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # (obs/perf/{roofline,regress,merge}.main); dispatch before the ledger
     # parser so their flags don't have to round-trip through it
     argv_l = list(sys.argv[1:] if argv is None else argv)
-    if argv_l and argv_l[0] in ("roofline", "regress", "merge"):
+    if argv_l and argv_l[0] in (
+        "roofline", "regress", "merge", "timeline", "slo"
+    ):
         import importlib
 
         mod = importlib.import_module(
@@ -471,6 +498,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser(
         "merge", add_help=False,
         help="join per-process multihost ledgers with cross-host skew stats",
+    )
+    sub.add_parser(
+        "timeline", add_help=False,
+        help="unified performance timeline: Chrome-trace/Perfetto export "
+        "+ step-time drift and host-straggler detection",
+    )
+    sub.add_parser(
+        "slo", add_help=False,
+        help="service-level objectives: burn-rate verdict over serve "
+        "latency buckets, step-time and halo-share ceilings",
     )
 
     args = p.parse_args(argv_l)

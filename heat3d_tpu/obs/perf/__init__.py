@@ -3,7 +3,7 @@ regress?
 
 The base obs package (ledger / metrics / trace) makes runs *explainable*;
 this layer makes them *judged* (docs/OBSERVABILITY.md §"Performance
-observability"). Four instruments:
+observability"). Six instruments:
 
 - :mod:`~heat3d_tpu.obs.perf.profiling` — ``--profile DIR`` device-trace
   capture on every entry point, with the artifact path and the capture
@@ -23,6 +23,15 @@ observability"). Four instruments:
 - :mod:`~heat3d_tpu.obs.perf.merge` — ``heat3d obs merge``: join the
   per-process ledgers of a multihost run into one timeline with
   cross-host skew stats.
+- :mod:`~heat3d_tpu.obs.perf.timeline` — ``heat3d obs timeline``: one
+  normalized event model over ledger + merged streams + profile
+  captures; Chrome-trace/Perfetto export, per-phase device totals (the
+  measured side of ``roofline --from-profile``), and step-time
+  drift / host-straggler detection (``obs_anomaly`` events).
+- :mod:`~heat3d_tpu.obs.perf.slo` — ``heat3d obs slo``: declarative
+  service-level objectives (per-bucket serve latency, step-time and
+  halo-share ceilings) evaluated into a burn-rate verdict; rc 1 only on
+  breach.
 
 Failure posture (inherited from obs): perf telemetry never kills the run
 it observes — profiling and cost-analysis errors degrade to a ledger note.

@@ -65,6 +65,22 @@ METRICS: Dict[str, Tuple[str, int]] = {
 }
 
 
+def band_status(
+    delta_pct: float,
+    warn_pct: float = DEFAULT_WARN_PCT,
+    fail_pct: float = DEFAULT_FAIL_PCT,
+) -> str:
+    """pass/warn/fail for a signed regression percentage (positive =
+    worse) — THE tolerance-band rule. :func:`compare` and the timeline
+    drift detector (``obs.perf.timeline.detect_anomalies``) share it, so
+    a step-time anomaly and a bench regression are judged by one band."""
+    if delta_pct > fail_pct:
+        return "fail"
+    if delta_pct > warn_pct:
+        return "warn"
+    return "pass"
+
+
 def _platform_class(row: Dict[str, Any]) -> str:
     """The comparability class: platform, with CPU-fallback driver records
     folded into 'cpu'. Rows predating the platform field are 'tpu' (the
@@ -251,11 +267,7 @@ def compare(
             skipped.append({"row": label, "reason": "zero baseline"})
             continue
         delta = (baseline - cur_v) / abs(baseline) * 100.0 * direction
-        status = "pass"
-        if delta > fail_pct:
-            status = "fail"
-        elif delta > warn_pct:
-            status = "warn"
+        status = band_status(delta, warn_pct, fail_pct)
         comp = {
             "row": label,
             "metric": field,

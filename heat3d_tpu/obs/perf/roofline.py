@@ -381,6 +381,202 @@ def print_live_table(
         )
 
 
+# ---- profile -> roofline join ----------------------------------------------
+
+
+def phase_cost_records(cfg) -> Dict[str, Dict[str, Any]]:
+    """Compile-only cost records per phase program — the COST side of the
+    profile→roofline join (:func:`profile_join_records`): each phase of
+    ``parallel.step.phase_programs`` lowered over an abstract sharded
+    field and read through ``compiled.cost_analysis()``. No timing, no
+    device_put — the measured side comes from the profile capture."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat3d_tpu.models.heat3d import _select_backend
+    from heat3d_tpu.parallel.step import phase_programs
+    from heat3d_tpu.parallel.topology import build_mesh, field_sharding
+
+    mesh = build_mesh(cfg.mesh)
+    sharding = field_sharding(mesh, cfg.mesh)
+    programs = phase_programs(cfg, mesh, _select_backend(cfg))
+    aval = jax.ShapeDtypeStruct(
+        cfg.padded_shape, jnp.dtype(cfg.precision.storage), sharding=sharding
+    )
+    out: Dict[str, Dict[str, Any]] = {}
+    seen: Dict[int, str] = {}
+    for phase, fn in programs.items():
+        if id(fn) in seen:  # fused_dma aliases the step program
+            rec = dict(out[seen[id(fn)]])
+            rec["alias_of"] = seen[id(fn)]
+            out[phase] = rec
+            continue
+        try:
+            compiled = jax.jit(fn).lower(aval).compile()
+            flops, bytes_ = extract_cost(compiled.cost_analysis())
+            out[phase] = {"flops": flops, "bytes": bytes_}
+        except Exception as e:  # noqa: BLE001 - keep the join best-effort
+            out[phase] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            continue
+        seen[id(fn)] = phase
+    return out
+
+
+def _phase_calls(phase: str, steps: int, tb: int) -> Optional[int]:
+    """How many times the phase program ran across ``steps`` updates:
+    one stencil sweep per update; one exchange (one fused kernel) per tb
+    updates. None means "total time only, no achieved rate": residual
+    cadence is run-configured and not recoverable from the capture, and
+    the ``step`` scope's device time is EXCLUSIVE (ops inside the inner
+    stencil/halo scopes attribute there, leaving only dispatch glue) —
+    dividing the FULL step program's cost by glue-only time would claim
+    absurd fractions of peak."""
+    from heat3d_tpu.parallel.step import (
+        PHASE_FUSED,
+        PHASE_HALO,
+        PHASE_STENCIL,
+    )
+
+    if phase == PHASE_STENCIL:
+        return max(1, steps)
+    if phase in (PHASE_HALO, PHASE_FUSED):
+        return max(1, steps // max(1, tb))
+    return None
+
+
+def profile_join_records(
+    cfg, phase_us: Dict[str, float], steps: int
+) -> List[Dict[str, Any]]:
+    """THE join (ROADMAP carry-over from PR 3): measured per-phase DEVICE
+    time from a ``--profile`` capture (``obs.perf.timeline
+    .profile_phase_totals`` — keyed by the ``heat3d.*`` named scopes)
+    against the cost-analysis FLOPs/bytes of the same-named phase
+    programs. One record per phase: total device time, its share of
+    attributed device time, per-call device seconds (``steps`` and
+    ``cfg.time_blocking`` set the call counts), and the achieved
+    GFLOP/s / GB/s those imply — "stencil at X% of HBM peak, halo at Y%"
+    from measured times, not span wall-clock."""
+    from heat3d_tpu.parallel.step import PHASES
+
+    costs = phase_cost_records(cfg)
+    tb = cfg.time_blocking
+    attributed = sum(
+        us for ph, us in phase_us.items() if ph != "(unattributed)"
+    )
+    records: List[Dict[str, Any]] = []
+    order = (
+        [ph for ph in PHASES if ph in costs]
+        + [ph for ph in costs if ph not in PHASES]
+        + [ph for ph in sorted(phase_us) if ph not in costs]
+    )
+    for phase in order:
+        cost = costs.get(phase, {})
+        us = phase_us.get(phase)
+        rec: Dict[str, Any] = {
+            "phase": phase,
+            "device_us": None if us is None else round(us, 3),
+            "share": (
+                round(us / attributed, 4)
+                if us is not None and attributed > 0
+                and phase != "(unattributed)"
+                else None
+            ),
+            "flops": cost.get("flops"),
+            "bytes": cost.get("bytes"),
+        }
+        if cost.get("error"):
+            rec["error"] = cost["error"]
+        if cost.get("alias_of"):
+            rec["alias_of"] = cost["alias_of"]
+        calls = _phase_calls(phase, steps, tb)
+        if us is not None and calls:
+            sec = us * 1e-6 / calls
+            rec["calls"] = calls
+            rec["seconds"] = sec
+            flops, bytes_ = cost.get("flops"), cost.get("bytes")
+            rec["gflops"] = (flops / sec / 1e9) if flops and sec > 0 else None
+            rec["gbps"] = (bytes_ / sec / 1e9) if bytes_ and sec > 0 else None
+        records.append(rec)
+    return records
+
+
+def print_profile_table(
+    cfg, records: List[Dict[str, Any]], platform: str, steps: int,
+    artifact: str, out=None,
+) -> None:
+    """The measured-device-time achieved-vs-peak table ``heat3d obs
+    roofline --from-profile`` prints. Same peak specs and %-of-peak
+    semantics as the live table; the time column is DEVICE time from the
+    capture, divided over the calls the run made."""
+    out = out or sys.stdout
+    spec = peak_spec(platform)
+    mem, vec = spec.get("mem_gbps"), spec.get("vector_gflops")
+    grid = "x".join(str(g) for g in cfg.grid.shape)
+    print(
+        f"roofline from profile [{platform}] grid={grid} "
+        f"stencil={cfg.stencil.kind} tb={cfg.time_blocking} steps={steps} "
+        f"(peaks: mem {mem or '?'} GB/s, vector {vec or '?'} GFLOP/s)\n"
+        f"  measured device time: {artifact}",
+        file=out,
+    )
+    print(
+        f"{'phase':<16} {'dev total':>10} {'share':>6} {'per-call':>10} "
+        f"{'GFLOP/s':>9} {'GB/s':>8} {'%flops':>8} {'%mem':>8}",
+        file=out,
+    )
+    for r in records:
+        if r.get("error"):
+            print(f"{r['phase']:<16} cost error: {r['error']}", file=out)
+            continue
+        dev = (
+            f"{r['device_us'] / 1e3:.3f}ms"
+            if r.get("device_us") is not None
+            else "-"
+        )
+        share = f"{r['share']:.1%}" if r.get("share") is not None else "-"
+        per_call = (
+            f"{r['seconds'] * 1e6:.1f}us" if r.get("seconds") else "-"
+        )
+        gf = f"{r['gflops']:.2f}" if r.get("gflops") is not None else "-"
+        gb = f"{r['gbps']:.2f}" if r.get("gbps") is not None else "-"
+        alias = f" (= {r['alias_of']})" if r.get("alias_of") else ""
+        print(
+            f"{r['phase']:<16} {dev:>10} {share:>6} {per_call:>10} "
+            f"{gf:>9} {gb:>8} {_pct(r.get('gflops'), vec):>8} "
+            f"{_pct(r.get('gbps'), mem):>8}{alias}",
+            file=out,
+        )
+
+
+def _steps_from_ledger(path: str, run_id: Optional[str] = None) -> Optional[int]:
+    """Stepped updates of ONE run segment — the ``steps`` fields of its
+    ok run_loop/chunk spans. The profile bracket covers exactly one
+    run's timed loop (``--profile``'s documented scope), but ledgers
+    hold many segments (APPEND bench sessions thread one
+    ``$HEAT3D_LEDGER`` through every row) — summing across them would
+    inflate the step count and corrupt every per-call rate. Default:
+    the LAST segment with step spans (the run that just wrote the
+    capture); ``run_id`` selects another explicitly."""
+    from heat3d_tpu.obs.cli import STEP_SPANS, read_ledger
+
+    per_run: Dict[str, int] = {}
+    order: List[str] = []
+    for r in read_ledger(path):
+        if (
+            r.get("kind") == "span"
+            and r.get("event") in STEP_SPANS
+            and r.get("status") == "ok"
+            and isinstance(r.get("steps"), int)
+        ):
+            rid = str(r.get("run_id"))
+            if rid not in per_run:
+                order.append(rid)
+            per_run[rid] = per_run.get(rid, 0) + r["steps"]
+    if run_id is not None:
+        return per_run.get(str(run_id)) or None
+    return (per_run[order[-1]] if order else 0) or None
+
+
 # ---- peak calibration -------------------------------------------------------
 
 
@@ -721,6 +917,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--time-blocking", type=int, default=1)
     ap.add_argument("--iters", type=int, default=3,
                     help="(live mode) timing iterations per phase")
+    ap.add_argument("--from-profile", default=None, metavar="DIR",
+                    help="join MEASURED per-phase device times from a "
+                    "--profile capture (dir or .xplane.pb) onto this "
+                    "config's cost_analysis model — achieved-vs-peak from "
+                    "device time, not span wall-clock (needs the config "
+                    "flags to match the profiled run)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="(with --from-profile) updates the capture "
+                    "covers; default: reconstructed from --ledger's "
+                    "run_loop/chunk spans, else 1 (per-call rates then "
+                    "read as per-capture)")
+    ap.add_argument("--ledger", default=None,
+                    help="(with --from-profile) run ledger of the "
+                    "profiled run — supplies the step count (the LAST "
+                    "run segment with step spans; --run selects another)")
+    ap.add_argument("--run", default=None, metavar="RUN_ID",
+                    help="(with --from-profile --ledger) the ledger run "
+                    "segment the capture belongs to")
     ap.add_argument("--calibrate", action="store_true",
                     help="measure a compute-bound 27pt tb=1 stencil phase "
                     "and cache its achieved GFLOP/s as this chip "
@@ -754,6 +968,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"{rec['vector_gflops']:.2f} GFLOP/s "
                 f"(stored in {rec['path']})"
             )
+        return 0
+
+    if args.from_profile:
+        import jax
+
+        from heat3d_tpu.obs.perf.timeline import profile_phase_totals
+
+        try:
+            phase_us, artifact = profile_phase_totals(args.from_profile)
+        except (RuntimeError, OSError) as e:
+            print(f"roofline --from-profile: {e}", file=sys.stderr)
+            return 1
+        steps = args.steps
+        if steps is None and args.ledger:
+            try:
+                steps = _steps_from_ledger(args.ledger, run_id=args.run)
+            except OSError as e:
+                print(
+                    f"roofline --from-profile: cannot read ledger: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+            if steps is None:
+                which = (
+                    f"run {args.run}" if args.run else "any run segment"
+                )
+                print(
+                    f"roofline --from-profile: no ok step spans for "
+                    f"{which} in {args.ledger} — treating the capture as "
+                    "ONE update (rates read as per-capture)",
+                    file=sys.stderr,
+                )
+        elif steps is None:
+            print(
+                "roofline --from-profile: no --steps/--ledger — treating "
+                "the capture as ONE update (rates read as per-capture)",
+                file=sys.stderr,
+            )
+        steps = steps or 1
+        cfg = _cfg_from_args(args)
+        records = profile_join_records(cfg, phase_us, steps)
+        platform = jax.default_backend()
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "platform": platform,
+                        "artifact": artifact,
+                        "steps": steps,
+                        "phases": records,
+                    }
+                )
+            )
+        else:
+            print_profile_table(cfg, records, platform, steps, artifact)
         return 0
 
     if args.results:

@@ -15,10 +15,13 @@ the phase boundaries the roofline analysis cares about:
   runtime regions (warmup, checkpoint IO, heal waits): shows up on the
   host timeline of a captured trace.
 
-Phase names used by the step builders (the contract
-``summarize_trace.py --phases`` groups by; keep in sync with
+Phase names used by the step builders (the contract the
+``obs/perf/timeline.py`` phase tables and the profile→roofline join
+group by — canonical list: ``parallel.step.PHASES``; keep in sync with
 docs/OBSERVABILITY.md):
 
+- ``heat3d.step`` (the whole step/superstep program — dispatch glue
+  attributes here instead of ``(unattributed)``)
 - ``heat3d.halo_exchange`` (and ``heat3d.halo.<axis>`` per axis)
 - ``heat3d.stencil``
 - ``heat3d.fused_dma``

@@ -26,7 +26,7 @@ from heat3d_tpu.core.config import (
     SolverConfig,
 )
 from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
-from heat3d_tpu.obs.trace import named_phase
+from heat3d_tpu.obs.trace import named_phase, scoped
 from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
 from heat3d_tpu.parallel.halo import exchange_halo
 from heat3d_tpu.utils.compat import shard_map
@@ -914,15 +914,27 @@ def make_step_fn(
                 r = lax.psum(r, axes)
             return u_new, r
 
-        return shard_map(
-            local, mesh=mesh, in_specs=spec, out_specs=(spec, P()), check_vma=False
+        # scoped(PHASE_STEP, ...): the whole-step heat3d.step named scope
+        # (trace-time metadata only) — profiled ops outside the inner
+        # stencil/halo/residual scopes (dispatch glue, padding pins)
+        # attribute to "step" instead of (unattributed), which is what the
+        # profile→roofline join keys on (obs/perf/timeline.py)
+        return scoped(
+            PHASE_STEP,
+            shard_map(
+                local, mesh=mesh, in_specs=spec, out_specs=(spec, P()),
+                check_vma=False,
+            ),
         )
 
     def local(u_local):
         return local_step(u_local, taps, cfg, compute_padded)
 
-    return shard_map(
-        local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    return scoped(
+        PHASE_STEP,
+        shard_map(
+            local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        ),
     )
 
 
@@ -957,9 +969,12 @@ def make_superstep_fn(
             def local_fused2(u_local):
                 return _local_step_fused_dma(u_local, taps2, cfg, fused2)
 
-            return shard_map(
-                local_fused2, mesh=mesh, in_specs=spec2, out_specs=spec2,
-                check_vma=False,
+            return scoped(
+                PHASE_STEP,
+                shard_map(
+                    local_fused2, mesh=mesh, in_specs=spec2,
+                    out_specs=spec2, check_vma=False,
+                ),
             )
         raise ValueError(
             f"time_blocking={cfg.time_blocking} and overlap=True are "
@@ -1018,9 +1033,12 @@ def make_superstep_fn(
                             u_local, taps, cfg, direct2
                         )
 
-            return shard_map(
-                local2, mesh=mesh, in_specs=spec, out_specs=spec,
-                check_vma=False,
+            return scoped(
+                PHASE_STEP,
+                shard_map(
+                    local2, mesh=mesh, in_specs=spec, out_specs=spec,
+                    check_vma=False,
+                ),
             )
 
     # The fused k-sweep streaming kernel (k=2..4): keeps the width-k
@@ -1055,9 +1073,12 @@ def make_superstep_fn(
                     out_dtype=jnp.dtype(cfg.precision.storage),
                 )
 
-        return shard_map(
-            localk, mesh=mesh, in_specs=spec, out_specs=spec,
-            check_vma=False,
+        return scoped(
+            PHASE_STEP,
+            shard_map(
+                localk, mesh=mesh, in_specs=spec, out_specs=spec,
+                check_vma=False,
+            ),
         )
 
     # Fallback: k compute_padded applications with jnp ring recompute —
@@ -1065,8 +1086,11 @@ def make_superstep_fn(
     def local(u_local):
         return _local_stepk(u_local, taps, cfg, compute_padded)
 
-    return shard_map(
-        local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    return scoped(
+        PHASE_STEP,
+        shard_map(
+            local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        ),
     )
 
 
@@ -1210,6 +1234,13 @@ PHASE_STENCIL = "stencil"
 PHASE_HALO = "halo_exchange"
 PHASE_FUSED = "fused_dma"
 PHASE_RESIDUAL = "residual"
+
+# The canonical phase vocabulary, in roofline-table order — the
+# profile→roofline join iterates it and keys per-phase call counts on
+# the PHASE_* constants (obs/perf/roofline.profile_join_records /
+# _phase_calls); obs/perf/timeline.normalize_phase folds trace scopes
+# onto the same names.
+PHASES = (PHASE_STEP, PHASE_STENCIL, PHASE_HALO, PHASE_FUSED, PHASE_RESIDUAL)
 
 
 def phase_programs(

@@ -156,6 +156,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="save each final field as DIR/req-<id>.npy")
     p.add_argument("--ledger", default=None,
                    help="run ledger path (default $HEAT3D_LEDGER)")
+    p.add_argument("--slo", default=None, metavar="SPEC.json",
+                   help="evaluate service-level objectives against this "
+                   "drain (default $HEAT3D_SLO_SPEC when set; "
+                   "obs/perf/slo.py) — verdict prints to stderr, an "
+                   "objective BREACH exits 1 even when every result "
+                   "delivered")
     args = p.parse_args(argv)
 
     obs.activate(args.ledger, meta={"entry": "serve"})
@@ -226,10 +232,23 @@ def _main(args) -> int:
         )
         return 2
 
-    if args.out:
-        import os
+    import os
 
+    if args.out:
         os.makedirs(args.out, exist_ok=True)
+
+    # SLO spec validates BEFORE the drain: a typo'd objective file must
+    # not surface only after the batches already executed
+    slo_spec = None
+    if args.slo or os.environ.get("HEAT3D_SLO_SPEC"):
+        from heat3d_tpu.obs.perf import slo as slo_mod
+
+        try:
+            slo_spec = slo_mod.load_spec(args.slo)
+        except OSError as e:
+            # the same clean rc-2 exit every other bad input takes (the
+            # outer handler catches ValueError)
+            raise ValueError(f"--slo: {e}") from None
 
     from heat3d_tpu.serve.queue import ScenarioQueue
 
@@ -253,6 +272,38 @@ def _main(args) -> int:
         )
         return 1
     log.info("serve: %d result(s) streamed", n)
+
+    # SLO wiring (docs/SERVING.md "SLOs"): judge THIS drain against the
+    # declarative objectives — evaluated from the queue's own summary
+    # (the same dict the drain-final serve_metrics_summary event carried),
+    # so the verdict is live, not a ledger re-read. Verdict goes to
+    # stderr (stdout is the result stream); a breach is rc 1.
+    if slo_spec is not None:
+        from heat3d_tpu.obs.perf import slo as slo_mod
+
+        report = slo_mod.evaluate(
+            [], slo_spec, serve_summary={
+                **queue.metrics_summary(), "source": "live queue",
+            },
+        )
+        slo_mod.record_verdict(report)
+        slo_mod.print_report(report, out=sys.stderr)
+        # only serve_latency objectives are judgeable from a drain (the
+        # queue has no step spans or device profile) — say so, so a
+        # mixed spec's step/halo ceilings don't read as enforced here
+        other = [
+            o["name"]
+            for o in report["objectives"]
+            if o["kind"] != "serve_latency"
+        ]
+        if other:
+            print(
+                f"heat3d serve: note: {', '.join(other)} not evaluable "
+                "at drain time — run `heat3d obs slo <ledger>` post-hoc",
+                file=sys.stderr,
+            )
+        if report["verdict"] == "breach":
+            return 1
     return 0
 
 

@@ -15,7 +15,11 @@ Observability: every submission lands a ``serve_submit`` ledger event,
 every executed batch a ``serve_batch_start`` point + a ``serve_batch``
 span, every delivered result a ``serve_result`` event with the
 request's queue latency; the metrics registry carries queue depth,
-batch-size and per-request latency histograms. Knobs:
+batch-size and per-request latency histograms (bucket-labelled). Every
+``drain()`` additionally closes with ONE ``serve_metrics_summary``
+event — per-bucket latency p50/p95/max plus the depth high-water mark —
+so post-hoc SLO evaluation (``heat3d obs slo``; obs/perf/slo.py) works
+from the ledger alone, never the live registry. Knobs:
 ``HEAT3D_SERVE_QUEUE`` caps the pending depth (submit raises when
 full), ``HEAT3D_SERVE_MAX_BATCH`` caps members per packed batch.
 """
@@ -32,6 +36,7 @@ import numpy as np
 
 from heat3d_tpu import obs
 from heat3d_tpu.core.config import SolverConfig
+from heat3d_tpu.obs.metrics import HISTOGRAM_SAMPLE_CAP
 from heat3d_tpu.serve.ensemble import EnsembleSolver
 from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch, solver_bucket_key
 from heat3d_tpu.utils.logging import get_logger
@@ -123,6 +128,17 @@ class ScenarioQueue:
         self._pending: "OrderedDict[int, _Pending]" = OrderedDict()
         self._next_id = 0
         self._solvers: Dict[Tuple, EnsembleSolver] = {}
+        # cumulative per-bucket latency stats + queue-depth high-water
+        # mark: the drain-final serve_metrics_summary event reports these
+        # so post-hoc SLO evaluation (obs/perf/slo.py) never needs the
+        # live registry. The sample reservoir is bounded by the SAME cap
+        # as the metrics layer (a service queue lives for millions of
+        # requests; count/max stay exact past the cap, percentiles note
+        # `clipped` — obs.metrics's rule).
+        self._bucket_stats: Dict[str, Dict] = {}
+        self._depth_max = 0
+        self._batches = 0
+        self._delivered = 0
         self._depth_gauge = obs.REGISTRY.gauge(
             "serve_queue_depth", "pending scenario requests"
         )
@@ -167,6 +183,7 @@ class ScenarioQueue:
             submitted_at=time.monotonic(),
         )
         self._depth_gauge.set(len(self._pending))
+        self._depth_max = max(self._depth_max, len(self._pending))
         obs.get().event(
             "serve_submit",
             request_id=rid,
@@ -239,8 +256,42 @@ class ScenarioQueue:
         for rid in order:
             if rid in results:
                 yield results[rid]
+        # drain-final summary (even on a partial drain — the batches that
+        # executed are real): per-bucket p50/p95/max queue latency and the
+        # depth high-water mark, as one ledger event, so SLO evaluation
+        # works from the ledger alone (docs/SERVING.md "SLOs")
+        obs.get().event("serve_metrics_summary", **self.metrics_summary())
         if err is not None:
             raise err
+
+    def metrics_summary(self) -> Dict[str, object]:
+        """Cumulative serve health over this queue's lifetime: per-bucket
+        queue-latency count/p50/p95/max, the pending-depth high-water
+        mark, and batch/delivery counters — the dict the drain-final
+        ``serve_metrics_summary`` ledger event carries and ``heat3d serve
+        --slo`` evaluates live (obs/perf/slo.py)."""
+        from heat3d_tpu.obs.metrics import percentile
+
+        buckets = {}
+        for bucket, st in sorted(self._bucket_stats.items()):
+            rec = {
+                "count": st["count"],
+                "p50_s": round(percentile(st["samples"], 50), 6),
+                "p95_s": round(percentile(st["samples"], 95), 6),
+                "max_s": round(st["max"], 6),
+            }
+            if st["clipped"]:
+                # percentiles cover the stored reservoir only, never to
+                # be mistaken for exact (count/max stay exact)
+                rec["clipped"] = True
+            buckets[bucket] = rec
+        return {
+            "buckets": buckets,
+            "depth_max": self._depth_max,
+            "batches": self._batches,
+            "delivered": self._delivered,
+            "pending": len(self._pending),
+        }
 
     def serve_batches(self) -> Iterator[List[ServeResult]]:
         """Pack and execute pending requests bucket by bucket, yielding
@@ -258,12 +309,14 @@ class ScenarioQueue:
         batch = self._pad_batch(base, members, padded)
         solver = self._solver_for(batch, padded)
         self._batch_hist.observe(len(chunk))
+        self._batches += 1
+        bucket_s = str(batch.bucket_key())
         obs.get().event(
             "serve_batch_start",
             members=len(chunk),
             padded=padded,
             request_ids=[p.request_id for p in chunk],
-            bucket=str(batch.bucket_key()),
+            bucket=bucket_s,
             mesh=list(solver.cfg.mesh.shape),
             batch_mesh=solver.batch_mesh,
             time_blocking=solver.cfg.time_blocking,
@@ -306,7 +359,20 @@ class ScenarioQueue:
         for i, p in enumerate(chunk):
             self._pending.pop(p.request_id, None)
             latency = now - p.submitted_at
-            self._latency_hist.observe(latency)
+            # bucket-labelled: the SLO layer judges latency PER BUCKET (a
+            # big-grid bucket legitimately runs slower than a small one)
+            self._latency_hist.observe(latency, bucket=bucket_s)
+            st = self._bucket_stats.setdefault(
+                bucket_s,
+                {"count": 0, "max": 0.0, "samples": [], "clipped": False},
+            )
+            st["count"] += 1
+            st["max"] = max(st["max"], latency)
+            if len(st["samples"]) < HISTOGRAM_SAMPLE_CAP:
+                st["samples"].append(latency)
+            else:
+                st["clipped"] = True
+            self._delivered += 1
             obs.get().event(
                 "serve_result",
                 request_id=p.request_id,

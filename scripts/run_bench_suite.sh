@@ -251,6 +251,22 @@ fi
 python -m heat3d_tpu.obs.cli regress "$OUT" --start-line "$LINT_FROM" \
   --json | tee -a "$SUITE_LOG"
 
+# SLO + timeline smoke (informational, AFTER the regress gate): evaluate
+# the session ledger against the configured objectives ($HEAT3D_SLO_SPEC,
+# else the built-in generous defaults — the path stays exercised either
+# way) and export the session's Chrome-trace timeline next to the rows.
+# Both fail SOFT (a breach on a smoke ledger is a note, not a gate);
+# SKIP_SLO_SMOKE=1 skips. docs/OBSERVABILITY.md §7.
+if [[ -z "${SKIP_SLO_SMOKE:-}" ]]; then
+  python -m heat3d_tpu.obs.cli slo "$LEDGER" --json | tee -a "$SUITE_LOG" \
+    || note "suite: slo smoke verdict nonzero (rc=$?) — informational"
+  python -m heat3d_tpu.obs.cli timeline "$LEDGER" \
+    -o "${OUT%.jsonl}.trace.json" >> "$SUITE_LOG" 2>&1 \
+    || note "suite: timeline export failed (rc=$?) — informational"
+else
+  note "suite: slo/timeline smoke skipped (SKIP_SLO_SMOKE=1)"
+fi
+
 # Autotune smoke + cache-schema lint (informational, AFTER the gates so
 # their rc still decides the suite): a budgeted `tune run` over the FULL
 # extended time_blocking lattice (1..4 — deep tb included, so the

@@ -1,158 +1,35 @@
-"""Summarize a jax.profiler trace directory: per-op and per-PHASE device time.
+"""Thin wrapper: the trace-summary core now lives in
+``heat3d_tpu/obs/perf/timeline.py`` (the ``heat3d obs timeline``
+subsystem), promoted there so the xplane parsing, the per-phase device
+totals, and the profile→roofline join share one module — the same
+promotion pattern as scripts/roofline_check.py and scripts/ab_decide.py.
+This script keeps the historical invocation working:
 
-Reads the xplane protobuf the profiler writes and prints the top device ops
-by total self time — enough to attribute a roofline gap (DMA wait vs
-compute vs dispatch gaps) without shipping the trace to TensorBoard. Ops
-emitted under the solver's ``jax.named_scope`` brackets (``heat3d.stencil``,
-``heat3d.halo_exchange``, ``heat3d.fused_dma``, ``heat3d.residual`` — see
-heat3d_tpu/obs/trace.py and docs/OBSERVABILITY.md) carry the scope in
-their metadata name, so the summary also aggregates device time by OUR
-phases instead of raw XLA op names.
+    python scripts/summarize_trace.py TRACE_DIR_OR_XPLANE_PB
 
-The aggregation logic is pure and duck-typed (``pick_line`` /
-``aggregate_line`` / ``phase_totals``) so tests drive it with synthetic
-plane objects when the ``xplane_pb2`` proto module is absent
-(tests/test_obs.py).
+Same flag (one positional path), same output: top device ops by total
+self time plus the per-heat3d-phase table. The aggregation helpers are
+re-exported so existing importers (tests) keep working.
 """
 
 from __future__ import annotations
 
-import glob
 import os
-import re
 import sys
-from collections import defaultdict
 
-# innermost heat3d phase token in an op/metadata name: named_scope nests
-# (heat3d.stencil/heat3d.halo_exchange/...), and the INNERMOST scope is
-# the phase that op belongs to — findall + [-1] picks it. The (?!py\b)
-# lookahead keeps host-plane PYTHON FRAMES ("$heat3d.py:301 run") from
-# masquerading as a phase named "heat3d.py". Dotted sub-phases
-# ("heat3d.halo.x") are one token: the continuation admits further
-# components unless they open with a digit (XLA's ".N" op suffixes, as in
-# "fusion.2", are not phase path components).
-PHASE_RE = re.compile(
-    r"heat3d\.(?!py\b)[A-Za-z_][A-Za-z0-9_]*"
-    r"(?:\.(?!py\b)[A-Za-z_][A-Za-z0-9_]*)*"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from heat3d_tpu.obs.perf.timeline import (  # noqa: E402,F401
+    PHASE_RE,
+    aggregate_line,
+    find_xplane,
+    phase_name,
+    phase_totals,
+    pick_line,
+    summarize,
+    summarize_plane,
+    summarize_trace_main as main,
 )
-
-
-def find_xplane(logdir: str):
-    pats = os.path.join(logdir, "**", "*.xplane.pb")
-    files = sorted(glob.glob(pats, recursive=True))
-    return files[-1] if files else None
-
-
-def pick_line(lines):
-    """The ONE line to aggregate per plane. A device plane carries several
-    lines covering the SAME wall time (XLA Modules / XLA Ops / Steps);
-    summing across them would double-count. Pick the op-level line if
-    present, else the busiest line. ``lines`` must be pre-filtered to
-    non-empty (``ln.events``)."""
-
-    def line_us(line):
-        return sum(ev.duration_ps for ev in line.events) / 1e6
-
-    ops = [ln for ln in lines if "op" in ln.name.lower()]
-    return ops[0] if ops else max(lines, key=line_us)
-
-
-def aggregate_line(line, event_metadata):
-    """(totals_us, counts) per metadata name for one line's events.
-    ``event_metadata`` is the plane's metadata_id -> metadata mapping
-    (proto map or plain dict of objects with ``.name``)."""
-    totals = defaultdict(float)
-    counts = defaultdict(int)
-    for ev in line.events:
-        meta = event_metadata[ev.metadata_id]
-        totals[meta.name] += ev.duration_ps / 1e6
-        counts[meta.name] += 1
-    return totals, counts
-
-
-def phase_name(op_name: str):
-    """The heat3d phase an op belongs to (its innermost ``heat3d.*`` scope
-    token), or None for ops outside any named phase."""
-    hits = PHASE_RE.findall(op_name)
-    return hits[-1] if hits else None
-
-
-def phase_totals(totals):
-    """Group per-op totals by heat3d phase; unscoped time lands in
-    ``(unattributed)``."""
-    phases = defaultdict(float)
-    for name, us in totals.items():
-        phases[phase_name(name) or "(unattributed)"] += us
-    return dict(phases)
-
-
-def summarize_plane(plane, top: int = 25, out=None) -> None:
-    out = out or sys.stdout
-    lines = [ln for ln in plane.lines if ln.events]
-    if not lines:
-        return
-    line = pick_line(lines)
-    totals, counts = aggregate_line(line, plane.event_metadata)
-    print(
-        f"\n== {plane.name} [line: {line.name or '?'}] "
-        f"(total {sum(totals.values())/1e3:.2f} ms)",
-        file=out,
-    )
-    for name, us in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
-        print(f"  {us/1e3:9.3f} ms  x{counts[name]:<6} {name[:90]}", file=out)
-    phases = phase_totals(totals)
-    # a phase table with ONLY unattributed time is noise (a trace captured
-    # without the named scopes); print it when any phase resolved
-    if set(phases) - {"(unattributed)"}:
-        total_us = sum(phases.values()) or 1.0
-        print("  -- by heat3d phase --", file=out)
-        for name, us in sorted(phases.items(), key=lambda kv: -kv[1]):
-            print(
-                f"  {us/1e3:9.3f} ms  {100.0 * us / total_us:5.1f}%  {name}",
-                file=out,
-            )
-
-
-def summarize(path: str) -> int:
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
-    except ImportError:
-        # soft fallback: the capture itself succeeded, so don't fail the
-        # calling script — just point at the trace
-        print(
-            "no xplane_pb2 available; open the trace in TensorBoard "
-            f"(tensorboard --logdir {os.path.dirname(path)})"
-        )
-        return 0
-    xs = xplane_pb2.XSpace()
-    with open(path, "rb") as f:
-        xs.ParseFromString(f.read())
-    planes = [
-        p
-        for p in xs.planes
-        if "TPU" in p.name or "/device" in p.name.lower()
-    ]
-    if not planes:  # CPU-only trace: fall back to the host plane
-        planes = [p for p in xs.planes if p.lines]
-    for plane in planes:
-        summarize_plane(plane)
-    return 0
-
-
-def main() -> int:
-    if len(sys.argv) < 2:
-        print(__doc__, file=sys.stderr)
-        return 2
-    path = sys.argv[1]
-    if os.path.isdir(path):
-        xp = find_xplane(path)
-        if xp is None:
-            print(f"no .xplane.pb under {path}")
-            return 1
-        path = xp
-    print(f"trace: {path}")
-    return summarize(path)
-
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -780,3 +780,18 @@ def test_cli_run_writes_ledger_and_metrics_export(tmp_path, monkeypatch):
     assert close["rc"] == 0
     text = open(prom).read()
     assert "heat3d_step_latency_seconds" in text
+
+
+def test_summarize_trace_promotion_wrapper_reexports():
+    """ISSUE 8 satellite: the script is now a thin wrapper over the
+    promoted core in heat3d_tpu/obs/perf/timeline.py — same helpers,
+    same objects (the duck-typed tests above exercise them THROUGH the
+    wrapper, so the promotion cannot drift silently)."""
+    mod = _load_summarize_trace()
+    from heat3d_tpu.obs.perf import timeline
+
+    for name in ("pick_line", "aggregate_line", "phase_name",
+                 "phase_totals", "summarize", "summarize_plane",
+                 "find_xplane", "PHASE_RE"):
+        assert getattr(mod, name) is getattr(timeline, name)
+    assert mod.main is timeline.summarize_trace_main

@@ -643,3 +643,536 @@ def test_bench_probe_fast_path(monkeypatch, tmp_path):
     # without a configured ledger the helper is a no-op (no child spawned)
     monkeypatch.delenv("HEAT3D_LEDGER")
     bench._record_probe_skipped("cpu", "test2")
+
+
+# ---- unified timeline (obs/perf/timeline.py) ------------------------------
+
+
+def _fixture_ledger_events():
+    """A deterministic two-run-segment ledger: one run_start point, a
+    warmup span, and chunk spans with known t0/t1/ts placement. Spans are
+    written at close (ts = wall at t1), so wall start is ts - dur_s."""
+    evs = []
+    t0 = 1000.0  # wall anchor
+
+    def point(name, ts, **f):
+        evs.append({"ts": ts, "run_id": "r1", "proc": 0, "seq": len(evs),
+                    "event": name, "kind": "point", **f})
+
+    def span(name, start, dur, **f):
+        evs.append({"ts": start + dur, "run_id": "r1", "proc": 0,
+                    "seq": len(evs), "event": name, "kind": "span",
+                    "t0": 5.0 + (start - t0), "t1": 5.0 + (start - t0) + dur,
+                    "dur_s": dur, "depth": 0, "status": "ok", **f})
+
+    point("run_start", t0)
+    span("warmup", t0 + 0.5, 0.25)
+    span("chunk", t0 + 1.0, 0.4, steps=4)
+    span("chunk", t0 + 1.5, 0.4, steps=4)
+    point("run_summary", t0 + 2.0)
+    return evs
+
+
+def test_timeline_chrome_trace_golden(tmp_path):
+    """Golden Chrome-trace export from a fixture ledger + fake profile
+    totals: spans land as X slices at ts - dur with exact us placement,
+    points as instants, and the profile's per-phase aggregate as its own
+    labelled track."""
+    from heat3d_tpu.obs.perf.timeline import timeline_events, to_chrome_trace
+
+    tl = timeline_events(_fixture_ledger_events())
+    doc = to_chrome_trace(tl, profile_totals={"stencil": 800.0, "halo_exchange": 200.0})
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    # base is the earliest wall time = run_start at t0
+    x = [e for e in evs if e.get("ph") == "X"]
+    inst = [e for e in evs if e.get("ph") == "i"]
+    meta = [e for e in evs if e.get("ph") == "M"]
+    warm = next(e for e in x if e["name"] == "warmup")
+    assert warm["ts"] == pytest.approx(0.5e6) and warm["dur"] == pytest.approx(0.25e6)
+    chunks = [e for e in x if e["name"] == "chunk"]
+    assert [c["ts"] for c in chunks] == [pytest.approx(1.0e6), pytest.approx(1.5e6)]
+    assert {e["name"] for e in inst} == {"run_start", "run_summary"}
+    assert next(e for e in inst if e["name"] == "run_start")["ts"] == 0.0
+    # profile aggregate track: its own pid, one named thread per phase
+    names = {e["args"]["name"] for e in meta if e["name"] == "process_name"}
+    assert "ledger/proc0" in names
+    assert "device profile (per-phase aggregate)" in names
+    prof = [e for e in x if e["name"] in ("stencil", "halo_exchange")]
+    assert {e["name"]: e["dur"] for e in prof} == {
+        "stencil": 800.0, "halo_exchange": 200.0}
+    # the whole doc round-trips as JSON (what the CLI writes)
+    json.loads(json.dumps(doc))
+
+
+def test_timeline_cli_writes_trace_and_json(tmp_path, capsys):
+    from heat3d_tpu.obs.perf import timeline
+
+    led = tmp_path / "led.jsonl"
+    with open(led, "w") as f:
+        for e in _fixture_ledger_events():
+            f.write(json.dumps(e) + "\n")
+    out = tmp_path / "trace.json"
+    assert timeline.main([str(led), "-o", str(out), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["events"] == 5 and rep["spans"] == 3
+    assert rep["out"] == str(out)
+    doc = json.load(open(out))
+    assert any(e.get("name") == "chunk" for e in doc["traceEvents"])
+    # unreadable ledger: rc 2, not a traceback
+    assert timeline.main([str(tmp_path / "nope.jsonl"), "--json"]) == 2
+
+
+def test_device_phase_totals_duck_typed_and_halo_fold():
+    """The measured side of the roofline join, proto-free: device planes
+    aggregate ONE line each, heat3d.halo.<axis> sub-scopes fold into
+    halo_exchange, and unscoped time stays (unattributed)."""
+    from types import SimpleNamespace
+
+    from heat3d_tpu.obs.perf.timeline import (
+        device_phase_totals,
+        normalize_phase,
+    )
+
+    def ev(mid, dur_us):
+        return SimpleNamespace(metadata_id=mid, duration_ps=dur_us * 1e6)
+
+    meta = {
+        1: SimpleNamespace(name="heat3d.step/heat3d.stencil/fusion.1"),
+        2: SimpleNamespace(name="heat3d.halo_exchange/heat3d.halo.x/ppermute.2"),
+        3: SimpleNamespace(name="heat3d.halo_exchange/heat3d.halo.y/ppermute.3"),
+        4: SimpleNamespace(name="copy.9"),
+    }
+    plane = SimpleNamespace(
+        name="/device:TPU:0",
+        lines=[
+            SimpleNamespace(name="XLA Ops",
+                            events=[ev(1, 40.0), ev(2, 6.0), ev(3, 4.0), ev(4, 2.0)]),
+            SimpleNamespace(name="XLA Modules", events=[ev(4, 52.0)]),
+        ],
+        event_metadata=meta,
+    )
+    totals = device_phase_totals(SimpleNamespace(planes=[plane]))
+    assert totals["stencil"] == pytest.approx(40.0)
+    assert totals["halo_exchange"] == pytest.approx(10.0)  # x + y folded
+    assert totals["(unattributed)"] == pytest.approx(2.0)
+    assert normalize_phase("heat3d.halo.z") == "halo_exchange"
+    assert normalize_phase("heat3d.step") == "step"
+
+
+def _write_synthetic_xplane(tmp_path, stencil_us=40.0, halo_us=10.0,
+                            step_us=0.0):
+    xplane_pb2 = pytest.importorskip(
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2"
+    )
+    xs = xplane_pb2.XSpace()
+    p = xs.planes.add()
+    p.name = "/device:TPU:0"
+    p.event_metadata[1].id = 1
+    p.event_metadata[1].name = "heat3d.step/heat3d.stencil/fusion.1"
+    p.event_metadata[2].id = 2
+    p.event_metadata[2].name = "heat3d.halo_exchange/heat3d.halo.x/ppermute.3"
+    p.event_metadata[3].id = 3
+    p.event_metadata[3].name = "heat3d.step/copy.5"  # dispatch glue
+    ln = p.lines.add()
+    ln.name = "XLA Ops"
+    for mid, us in ((1, stencil_us), (2, halo_us), (3, step_us)):
+        if us <= 0:
+            continue
+        ev = ln.events.add()
+        ev.metadata_id = mid
+        ev.duration_ps = int(us * 1e6)
+    path = tmp_path / "prof" / "t.xplane.pb"
+    os.makedirs(path.parent, exist_ok=True)
+    path.write_bytes(xs.SerializeToString())
+    return str(path.parent)
+
+
+def test_roofline_from_profile_join_acceptance(tmp_path, capsys):
+    """THE acceptance criterion (ROADMAP PR 3 carry-over retired):
+    `heat3d obs roofline --from-profile DIR` on a CPU-capture fixture
+    prints a per-phase achieved-vs-peak table from MEASURED device times
+    — stencil and halo rows with a fraction of each peak."""
+    from heat3d_tpu.obs.perf import roofline
+
+    prof = _write_synthetic_xplane(
+        tmp_path, stencil_us=40.0, halo_us=10.0, step_us=2.0
+    )
+    rc = roofline.main(
+        ["--from-profile", prof, "--grid", "16", "--steps", "4",
+         "--backend", "jnp", "--json"]
+    )
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["steps"] == 4
+    by_phase = {r["phase"]: r for r in rep["phases"]}
+    stencil, halo = by_phase["stencil"], by_phase["halo_exchange"]
+    # measured device time from the fixture, split over 4 calls
+    assert stencil["device_us"] == pytest.approx(40.0)
+    assert stencil["calls"] == 4
+    assert stencil["seconds"] == pytest.approx(10e-6)
+    assert halo["device_us"] == pytest.approx(10.0)
+    # achieved rates divide REAL cost_analysis numbers by measured time
+    assert stencil["bytes"] and stencil["gbps"] == pytest.approx(
+        stencil["bytes"] / 10e-6 / 1e9
+    )
+    assert halo["bytes"] and halo["gbps"] > 0
+    # shares of attributed device time: 40/52, 10/52, 2/52
+    assert stencil["share"] == pytest.approx(40 / 52, abs=1e-3)
+    assert halo["share"] == pytest.approx(10 / 52, abs=1e-3)
+    # the step scope's device time is EXCLUSIVE (dispatch glue only):
+    # it reports time + share but NO achieved rate — full-program cost
+    # over glue-only seconds would claim absurd fractions of peak
+    step = by_phase["step"]
+    assert step["device_us"] == pytest.approx(2.0)
+    assert step.get("seconds") is None and step.get("gflops") is None
+    # and the human table renders with the peak columns
+    rc = roofline.main(
+        ["--from-profile", prof, "--grid", "16", "--steps", "4",
+         "--backend", "jnp"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "roofline from profile" in out and "%mem" in out
+    assert "stencil" in out and "halo_exchange" in out
+
+
+def test_roofline_from_profile_steps_from_ledger(tmp_path, capsys):
+    from heat3d_tpu.obs.perf import roofline
+
+    prof = _write_synthetic_xplane(tmp_path)
+    led = tmp_path / "led.jsonl"
+    with open(led, "w") as f:
+        for e in _fixture_ledger_events():  # run r1: two chunk spans x 4
+            f.write(json.dumps(e) + "\n")
+    rc = roofline.main(
+        ["--from-profile", prof, "--ledger", str(led), "--grid", "16",
+         "--backend", "jnp", "--json"]
+    )
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["steps"] == 8
+    # an APPEND-session ledger holds MANY run segments but the capture
+    # covers one: the step count comes from the LAST segment with step
+    # spans, and --run selects another explicitly
+    with open(led, "a") as f:
+        f.write(json.dumps({
+            "ts": 2000.0, "run_id": "r2", "proc": 0, "seq": 0,
+            "event": "run_loop", "kind": "span", "t0": 0.0, "t1": 0.3,
+            "dur_s": 0.3, "depth": 0, "status": "ok", "steps": 3,
+        }) + "\n")
+    for flags, want in ((["--ledger", str(led)], 3),
+                        (["--ledger", str(led), "--run", "r1"], 8)):
+        rc = roofline.main(
+            ["--from-profile", prof, "--grid", "16", "--backend", "jnp",
+             "--json"] + flags
+        )
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["steps"] == want
+    # a missing capture is a clean rc 1, not a traceback
+    assert roofline.main(
+        ["--from-profile", str(tmp_path / "empty"), "--grid", "16"]
+    ) == 1
+    # an unreadable ledger is a clean rc 2, not a traceback
+    assert roofline.main(
+        ["--from-profile", prof, "--ledger", str(tmp_path / "nope.jsonl"),
+         "--grid", "16", "--backend", "jnp"]
+    ) == 2
+    # a --run id absent from the ledger degrades to steps=1 with an
+    # honest note naming the run, not the false "no --steps/--ledger"
+    rc = roofline.main(
+        ["--from-profile", prof, "--ledger", str(led), "--run", "typo",
+         "--grid", "16", "--backend", "jnp", "--json"]
+    )
+    assert rc == 0
+    captured = capsys.readouterr()
+    rep = json.loads(captured.out.strip().splitlines()[-1])
+    assert rep["steps"] == 1
+    assert "no ok step spans for run typo" in captured.err
+
+
+# ---- drift / straggler detection ------------------------------------------
+
+
+def _chunk_span(dur, steps=4, proc=0, src="", seq=0, ts=0.0):
+    e = {"ts": ts, "run_id": "r1", "proc": proc, "seq": seq,
+         "event": "chunk", "kind": "span", "t0": 0.0, "t1": dur,
+         "dur_s": dur, "depth": 0, "status": "ok", "steps": steps}
+    if src:
+        e["src"] = src
+    return e
+
+
+def test_drift_detector_flags_injected_slowdown():
+    """Steady 100ms/step chunks then a sustained 2x slowdown: every
+    drifted sample past the seed window is flagged FAIL, and the rolling
+    baseline is NOT poisoned by the flagged samples (the last anomaly
+    still judges against the healthy baseline)."""
+    from heat3d_tpu.obs.perf.timeline import detect_anomalies
+
+    evs = [_chunk_span(0.4, seq=i, ts=float(i)) for i in range(6)]
+    evs += [_chunk_span(0.8, seq=6 + i, ts=6.0 + i) for i in range(3)]
+    anoms = detect_anomalies(evs)
+    drifts = [a for a in anoms if a["kind_"] == "span_drift"]
+    assert len(drifts) == 3
+    for a in drifts:
+        assert a["status"] == "fail"
+        assert a["delta_pct"] == pytest.approx(100.0, abs=0.1)
+        assert a["baseline_s"] == pytest.approx(0.1)  # per-step, unpoisoned
+        assert a["per_step"] is True
+    # a steady ledger detects nothing
+    assert detect_anomalies(
+        [_chunk_span(0.4, seq=i, ts=float(i)) for i in range(10)]
+    ) == []
+
+
+def test_drift_detector_warn_band_and_custom_tolerance():
+    from heat3d_tpu.obs.perf.timeline import detect_anomalies
+
+    evs = [_chunk_span(0.4, seq=i, ts=float(i)) for i in range(6)]
+    evs.append(_chunk_span(0.44, seq=6, ts=6.0))  # +10%: warn band
+    anoms = detect_anomalies(evs)
+    assert [a["status"] for a in anoms] == ["warn"]
+    # widened bands: the same ledger is clean
+    assert detect_anomalies(evs, warn_pct=20.0, fail_pct=30.0) == []
+
+
+def test_straggler_detector_on_merged_streams(tmp_path):
+    """Two src-tagged streams (an obs-merge'd pod ledger): the host
+    whose step p50 sits 2x above the fleet median is flagged; the
+    anomalies land as obs_anomaly ledger events that pass the taxonomy
+    lint."""
+    from heat3d_tpu.obs.perf.timeline import detect_anomalies, emit_anomalies
+
+    evs = []
+    for i in range(5):
+        evs.append(_chunk_span(0.4, proc=0, src="h0.jsonl", seq=i, ts=float(i)))
+        evs.append(_chunk_span(0.4, proc=0, src="h1.jsonl", seq=i, ts=float(i)))
+        evs.append(_chunk_span(0.8, proc=0, src="h2.jsonl", seq=i, ts=float(i)))
+    anoms = detect_anomalies(evs)
+    stragglers = [a for a in anoms if a["kind_"] == "host_straggler"]
+    assert len(stragglers) == 1
+    s = stragglers[0]
+    assert s["src"] == "h2.jsonl" and s["status"] == "fail"
+    assert s["delta_pct"] == pytest.approx(100.0, abs=0.1)
+
+    led = str(tmp_path / "anom.jsonl")
+    obs.activate(led, meta={"entry": "test"})
+    emit_anomalies(anoms)
+    obs.deactivate(rc=0)
+    recorded = [e for e in _read(led) if e["event"] == "obs_anomaly"]
+    assert len(recorded) == len(anoms)
+    assert recorded[0]["kind_"] == "host_straggler"
+    from heat3d_tpu.obs.check import main as check_main
+
+    assert check_main(["--taxonomy", led]) == 0
+
+
+def test_timeline_cli_multiledger_straggler(tmp_path, capsys):
+    """Several ledger paths merge src-tagged on the way into the CLI, so
+    the straggler surfaces from per-host files without a manual merge."""
+    from heat3d_tpu.obs.perf import timeline
+
+    for host, dur in (("h0", 0.4), ("h1", 0.4), ("h2", 1.2)):
+        with open(tmp_path / f"{host}.jsonl", "w") as f:
+            for i in range(5):
+                f.write(json.dumps(_chunk_span(dur, seq=i, ts=float(i))) + "\n")
+    rc = timeline.main(
+        [str(tmp_path / f"{h}.jsonl") for h in ("h0", "h1", "h2")]
+        + ["--json"]
+    )
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["streams"] == 3
+    stragglers = [
+        a for a in rep["anomalies"] if a["kind_"] == "host_straggler"
+    ]
+    assert len(stragglers) == 1 and stragglers[0]["src"] == "h2.jsonl"
+
+
+def test_summary_prints_anomaly_section(tmp_path, capsys):
+    """obs summary gains the drift section: an injected-drift ledger
+    prints ANOMALY lines from the same detector."""
+    from heat3d_tpu.obs.cli import main as obs_main
+
+    led = tmp_path / "led.jsonl"
+    with open(led, "w") as f:
+        for i in range(6):
+            f.write(json.dumps(_chunk_span(0.4, seq=i, ts=float(i))) + "\n")
+        f.write(json.dumps(_chunk_span(1.0, seq=6, ts=6.0)) + "\n")
+    assert obs_main(["summary", str(led)]) == 0
+    out = capsys.readouterr().out
+    assert "ANOMALY" in out and "chunk" in out
+
+
+# ---- SLOs (obs/perf/slo.py) ------------------------------------------------
+
+
+def _slo_ledger(tmp_path, p95=0.2, step_dur=None):
+    led = tmp_path / "slo_led.jsonl"
+    evs = [
+        {"ts": 1.0, "run_id": "r", "proc": 0, "seq": 0,
+         "event": "serve_metrics_summary", "kind": "point",
+         "buckets": {"((16, 16, 16), 'x')": {
+             "count": 8, "p50_s": p95 / 2, "p95_s": p95, "max_s": p95}},
+         "depth_max": 8, "batches": 2, "delivered": 8, "pending": 0},
+    ]
+    if step_dur is not None:
+        evs.append({"ts": 2.0, "run_id": "r", "proc": 0, "seq": 1,
+                    "event": "run_loop", "kind": "span", "t0": 0.0,
+                    "t1": step_dur, "dur_s": step_dur, "depth": 0,
+                    "status": "ok", "steps": 10})
+    with open(led, "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    return str(led)
+
+
+def _slo_spec(tmp_path, max_s, name="queue-p95", **extra):
+    spec = {"objectives": [
+        {"name": name, "kind": "serve_latency", "percentile": 95,
+         "max_s": max_s, **extra}]}
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(spec))
+    return str(path)
+
+
+def test_slo_rc_semantics_pass_warn_breach(tmp_path, capsys):
+    """rc mirrors obs regress: 1 ONLY on breach — pass, warn, and
+    no-data all exit 0."""
+    from heat3d_tpu.obs.perf import slo
+
+    led = _slo_ledger(tmp_path, p95=0.2)
+    # pass: 0.2 vs 1.0 ceiling (burn 0.2)
+    assert slo.main([led, "--spec", _slo_spec(tmp_path, 1.0), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["verdict"] == "pass"
+    assert rep["objectives"][0]["burn_rate"] == pytest.approx(0.2)
+    # warn: 0.2 vs 0.21 ceiling (burn ~0.95 >= warn_ratio 0.9) — still rc 0
+    assert slo.main([led, "--spec", _slo_spec(tmp_path, 0.21), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["verdict"] == "warn"
+    # breach: 0.2 vs 0.1 ceiling — rc 1
+    assert slo.main([led, "--spec", _slo_spec(tmp_path, 0.1), "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["verdict"] == "breach"
+    assert rep["objectives"][0]["burn_rate"] == pytest.approx(2.0)
+    # no data: a bucket filter matching nothing — rc 0, status no_data
+    assert slo.main(
+        [led, "--spec", _slo_spec(tmp_path, 0.1, bucket="(999,"), "--json"]
+    ) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["verdict"] == "pass"
+    assert rep["objectives"][0]["status"] == "no_data"
+    # unreadable spec / ledger: rc 2 (a gate must not pass vacuously)
+    assert slo.main([led, "--spec", str(tmp_path / "nope.json")]) == 2
+    assert slo.main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_slo_step_time_and_verdict_event(tmp_path, capsys):
+    from heat3d_tpu.obs.perf import slo
+
+    led = _slo_ledger(tmp_path, p95=0.2, step_dur=1.0)  # 0.1 s/step
+    spec = tmp_path / "spec2.json"
+    spec.write_text(json.dumps({"objectives": [
+        {"name": "step-p95", "kind": "step_time", "percentile": 95,
+         "max_s": 0.05}]}))
+    out_led = str(tmp_path / "verdict_led.jsonl")
+    obs.activate(out_led, meta={"entry": "test"})
+    rc = slo.main([led, "--spec", str(spec), "--json"])
+    obs.deactivate(rc=0)
+    assert rc == 1  # 0.1 s/step vs 0.05 ceiling
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["objectives"][0]["value"] == pytest.approx(0.1)
+    # the verdict landed as a taxonomy-valid slo_verdict ledger event
+    verdicts = [e for e in _read(out_led) if e["event"] == "slo_verdict"]
+    assert verdicts and verdicts[0]["verdict"] == "breach"
+    from heat3d_tpu.obs.check import main as check_main
+
+    assert check_main(["--taxonomy", out_led]) == 0
+
+
+def test_slo_halo_share_from_profile_and_no_data(tmp_path, capsys):
+    from heat3d_tpu.obs.perf import slo
+
+    led = _slo_ledger(tmp_path)
+    spec = tmp_path / "spec3.json"
+    spec.write_text(json.dumps({"objectives": [
+        {"name": "halo-share", "kind": "halo_share", "max_frac": 0.15}]}))
+    # without a profile: no_data, rc 0
+    assert slo.main([led, "--spec", str(spec), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["objectives"][0]["status"] == "no_data"
+    # with a capture where halo is 20% of attributed time: breach vs 0.15
+    prof = _write_synthetic_xplane(tmp_path, stencil_us=40.0, halo_us=10.0)
+    assert slo.main(
+        [led, "--spec", str(spec), "--profile", prof, "--json"]
+    ) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["objectives"][0]["value"] == pytest.approx(0.2)
+
+
+def test_slo_serve_result_reconstruction_fallback():
+    """Pre-summary ledgers still evaluate: serve_result queue latencies
+    reconstruct one (all) pseudo-bucket."""
+    from heat3d_tpu.obs.perf.slo import evaluate, load_spec
+
+    evs = [
+        {"event": "serve_result", "kind": "point", "queue_latency_s": v}
+        for v in (0.1, 0.2, 0.3)
+    ]
+    spec = {"objectives": [
+        {"name": "q", "kind": "serve_latency", "percentile": 95,
+         "max_s": 1.0}]}
+    rep = evaluate(evs, spec)
+    o = rep["objectives"][0]
+    assert o["bucket"] == "(all)" and o["value"] == pytest.approx(0.3)
+    assert rep["sources"]["serve"] == "serve_result reconstruction"
+    # default spec loads without any file and is marked as such
+    assert load_spec(None).get("default_spec") is True
+
+
+def test_slo_spec_validation_errors(tmp_path):
+    from heat3d_tpu.obs.perf.slo import load_spec
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"objectives": [{"kind": "nope", "max_s": 1}]}))
+    with pytest.raises(ValueError, match="kind"):
+        load_spec(str(bad))
+    bad.write_text(json.dumps({"objectives": [
+        {"kind": "serve_latency", "percentile": 95}]}))
+    with pytest.raises(ValueError, match="max_s"):
+        load_spec(str(bad))
+    bad.write_text(json.dumps({"objectives": [
+        {"kind": "serve_latency", "percentile": 75, "max_s": 1.0}]}))
+    with pytest.raises(ValueError, match="percentile"):
+        load_spec(str(bad))
+
+
+def test_drift_detector_never_crosses_run_boundaries():
+    """An APPEND-session ledger holds many differently-configured runs
+    (the suite ledger the CI timeline smoke reads): a grid-32 run at
+    0.1 s/step followed by a grid-256 run at 0.5 s/step is two healthy
+    runs, not drift — baselines are scoped per run segment, and the two
+    sequential runs are ONE host identity, so no straggler either."""
+    from heat3d_tpu.obs.perf.timeline import detect_anomalies
+
+    evs = []
+    for i in range(6):
+        e = _chunk_span(0.4, seq=i, ts=float(i))
+        e["run_id"] = "run-a"
+        evs.append(e)
+    for i in range(6):
+        e = _chunk_span(2.0, seq=6 + i, ts=6.0 + i)  # 5x slower per step
+        e["run_id"] = "run-b"
+        evs.append(e)
+    assert detect_anomalies(evs) == []
+    # drift WITHIN one of the segments still fires, tagged with its run
+    e = _chunk_span(4.0, seq=12, ts=12.0)
+    e["run_id"] = "run-b"
+    evs.append(e)
+    anoms = detect_anomalies(evs)
+    assert [a["status"] for a in anoms] == ["fail"]
+    assert anoms[0]["run_id_"] == "run-b"
+    assert anoms[0]["baseline_s"] == pytest.approx(0.5)

@@ -519,3 +519,124 @@ def test_ensemble_equivalence_on_cpu_mesh_tier1():
         f"stderr:\n{proc.stderr}"
     )
     assert "ENSEMBLE EQUIVALENCE OK" in proc.stdout
+
+
+def test_drain_emits_serve_metrics_summary_for_posthoc_slo(tmp_path):
+    """The drain-final ledger event (ISSUE 8 satellite): per-bucket
+    p50/p95/max queue latency + the depth high-water mark land in the
+    ledger, and the SLO layer evaluates per-bucket objectives from those
+    events ALONE — no live registry, no queue object."""
+    from heat3d_tpu.obs.perf.slo import evaluate
+    from heat3d_tpu.serve.queue import ScenarioQueue
+
+    led = str(tmp_path / "serve.jsonl")
+    obs.activate(led, meta={"entry": "test"})
+    try:
+        q = ScenarioQueue()
+        base_a, base_b = _base(grid=10), _base(grid=12)
+        for sc in HETERO:
+            q.submit(base_a, sc)
+        q.submit(base_b, Scenario(alpha=0.6, steps=2, seed=9))
+        assert len(q) == 4
+        list(q.drain())
+        summary = q.metrics_summary()
+    finally:
+        obs.deactivate(rc=0)
+
+    # the live summary: one bucket entry per structural key, full stats
+    assert summary["depth_max"] == 4
+    assert summary["delivered"] == 4 and summary["batches"] == 2
+    assert len(summary["buckets"]) == 2
+    for st in summary["buckets"].values():
+        assert st["count"] >= 1
+        assert 0.0 <= st["p50_s"] <= st["p95_s"] <= st["max_s"]
+
+    events = [json.loads(line) for line in open(led) if line.strip()]
+    finals = [e for e in events if e.get("event") == "serve_metrics_summary"]
+    assert len(finals) == 1  # one per drain
+    assert finals[0]["buckets"] == summary["buckets"]
+    assert finals[0]["depth_max"] == 4
+
+    # post-hoc SLO evaluation from the ledger events alone: the grid-10
+    # bucket is addressable by substring, and generous ceilings pass
+    spec = {"objectives": [
+        {"name": "p95-grid10", "kind": "serve_latency", "percentile": 95,
+         "max_s": 120.0, "bucket": "(10, 10, 10)"},
+        {"name": "p50-all", "kind": "serve_latency", "percentile": 50,
+         "max_s": 120.0},
+    ]}
+    rep = evaluate(events, spec)
+    assert rep["verdict"] == "pass"
+    assert rep["sources"]["serve"] == "serve_metrics_summary"
+    by_name = {o["name"]: o for o in rep["objectives"]}
+    assert "(10, 10, 10)" in by_name["p95-grid10"]["bucket"]
+    assert by_name["p50-all"]["status"] == "ok"
+    # a second drain appends a fresh cumulative summary
+    obs.activate(led, meta={"entry": "test"})
+    try:
+        q.submit(base_a, HETERO[0])
+        list(q.drain())
+    finally:
+        obs.deactivate(rc=0)
+    events = [json.loads(line) for line in open(led) if line.strip()]
+    finals = [e for e in events if e.get("event") == "serve_metrics_summary"]
+    assert len(finals) == 2
+    assert finals[1]["delivered"] == 5
+
+
+def test_bucket_latency_reservoir_is_bounded(monkeypatch):
+    """The per-bucket SLO stats reuse the metrics layer's sample cap: a
+    service queue alive for millions of requests must not grow an
+    unbounded latency list — count/max stay exact past the cap, the
+    percentiles mark themselves clipped."""
+    from heat3d_tpu.serve import queue as queue_mod
+
+    monkeypatch.setattr(queue_mod, "HISTOGRAM_SAMPLE_CAP", 2)
+    q = queue_mod.ScenarioQueue()
+    base = _base(grid=10, steps=1)
+    for sc in HETERO:
+        q.submit(base, sc)
+    list(q.drain())
+    summary = q.metrics_summary()
+    (st,) = summary["buckets"].values()
+    assert st["count"] == 3 and st["clipped"] is True
+    assert len(q._bucket_stats[next(iter(q._bucket_stats))]["samples"]) == 2
+
+
+def test_serve_cli_slo_wiring_rc_semantics(tmp_path, capsys):
+    """`heat3d serve --slo`: the spec validates BEFORE the drain (bad
+    spec = clean rc 2, zero results executed), a breaching drain exits 1
+    even though every result delivered, and a passing spec exits 0 with
+    the verdict on stderr (stdout stays the pure result stream)."""
+    from heat3d_tpu.serve.cli import main as serve_main
+
+    breach = tmp_path / "breach.json"
+    breach.write_text(json.dumps({"objectives": [
+        {"name": "q95", "kind": "serve_latency", "percentile": 95,
+         "max_s": 1e-9}]}))
+    assert serve_main(["--smoke", "--slo", str(breach)]) == 1
+    out, err = capsys.readouterr()
+    assert len(out.strip().splitlines()) == 3  # all results delivered
+    assert "BREACH" in err and "slo verdict: breach" in err
+
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"objectives": [
+        {"name": "q95", "kind": "serve_latency", "percentile": 95,
+         "max_s": 120.0},
+        {"name": "step95", "kind": "step_time", "percentile": 95,
+         "max_s": 1e-9}]}))
+    assert serve_main(["--smoke", "--slo", str(ok)]) == 0
+    out, err = capsys.readouterr()
+    assert len(out.strip().splitlines()) == 3
+    assert "slo verdict: pass" in err
+    # a mixed spec's non-serve objectives are NOT enforced at drain time
+    # (no step spans here) and the verdict says so explicitly — a
+    # breach-level step ceiling must not pass silently
+    assert "step95 not evaluable at drain time" in err
+    for line in out.strip().splitlines():
+        json.loads(line)  # stdout is still pure JSON results
+
+    # a missing/invalid spec fails BEFORE any batch executes
+    assert serve_main(["--smoke", "--slo", str(tmp_path / "nope.json")]) == 2
+    out, err = capsys.readouterr()
+    assert out.strip() == "" and "--slo" in err
