@@ -2,14 +2,18 @@
 
 Five AST/arithmetic checkers over the repo's own source (docs/ANALYSIS.md
 is the catalog), one shared finding/severity/suppression framework
-(:mod:`~heat3d_tpu.analysis.findings`), and the promoted data-lint cores
-behind ``scripts/check_ledger.py`` / ``scripts/check_provenance.py``.
+(:mod:`~heat3d_tpu.analysis.findings`), the promoted data-lint cores
+behind ``scripts/check_ledger.py`` / ``scripts/check_provenance.py``,
+and the IR tier (:mod:`~heat3d_tpu.analysis.ir`, ``heat3d lint --ir``)
+that traces the judged config matrix and certifies the closed jaxprs.
 ``heat3d lint`` (:mod:`~heat3d_tpu.analysis.cli`) is the operator/CI
 entry point: rc 1 only on unsuppressed error-severity findings.
 
-The checkers parse, they do not import, the code they audit — except
-where the arithmetic itself is the artifact under audit (VMEM budget
-estimators, the live knob surfaces), which is loaded deliberately.
+The source checkers parse, they do not import, the code they audit —
+except where the arithmetic itself is the artifact under audit (VMEM
+budget estimators, the live knob surfaces), which is loaded
+deliberately. The IR tier goes one step further and audits the
+*programs* the code builds, not the code.
 """
 
 from __future__ import annotations

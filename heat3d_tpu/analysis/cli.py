@@ -7,6 +7,16 @@ Usage::
     heat3d lint --checker vmem-budget    # one checker (repeatable / CSV)
     heat3d lint --write-baseline         # grandfather current findings
     heat3d lint --list                   # checker catalog
+    heat3d lint --ir [--json]            # IR-tier program verifier
+    heat3d lint --ir --checker ir-dtype  # one IR family
+
+``--ir`` switches to the IR-tier catalog (:mod:`heat3d_tpu.analysis.ir`):
+instead of parsing source, it traces the judged config matrix through
+the real step/superstep/ensemble builders and certifies the closed
+jaxprs (collective topology, halo footprint, dtype flow, compiled
+memory contract). Same severity/suppression/baseline machinery; IR
+findings fingerprint on (checker, config-key, invariant), so baselines
+survive jaxpr pretty-printer drift across jax versions.
 
 Severity policy (docs/ANALYSIS.md): rc 1 **only** on unsuppressed
 error-severity findings — warnings are drift that needs a decision, info
@@ -68,17 +78,18 @@ def run_checkers(root: str, names: List[str]) -> List[Finding]:
     return findings
 
 
-def _resolve_checkers(raw: List[str]) -> List[str]:
+def _resolve_checkers(raw: List[str], catalog=None) -> List[str]:
+    catalog = CHECKERS if catalog is None else catalog
     if not raw:
-        return list(CHECKERS)
+        return list(catalog)
     names: List[str] = []
     for item in raw:
         for name in item.split(","):
             name = name.strip()
-            if name not in CHECKERS:
+            if name not in catalog:
                 raise SystemExit(
                     f"heat3d lint: unknown checker {name!r} "
-                    f"(known: {', '.join(CHECKERS)})"
+                    f"(known: {', '.join(catalog)})"
                 )
             if name not in names:
                 names.append(name)
@@ -93,6 +104,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "findings.",
     )
     p.add_argument("--json", action="store_true", help="machine verdict")
+    p.add_argument(
+        "--ir", action="store_true",
+        help="run the IR-tier program verifier (trace the judged config "
+        "matrix and certify the closed jaxprs) instead of the source "
+        "checkers",
+    )
     p.add_argument(
         "--checker", action="append", default=[],
         help="run only this checker (repeatable, or comma-separated)",
@@ -121,17 +138,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = p.parse_args(argv)
 
+    if args.ir:
+        from heat3d_tpu.analysis.ir import IR_CHECKERS as catalog
+    else:
+        catalog = CHECKERS
+
     if args.list:
-        for name, modpath in CHECKERS.items():
+        for name, modpath in catalog.items():
             doc = (importlib.import_module(modpath).__doc__ or "").strip()
             print(f"{name}: {doc.splitlines()[0]}")
         return 0
 
     root = os.path.abspath(args.root) if args.root else astutil.repo_root()
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
-    names = _resolve_checkers(args.checker)
+    names = _resolve_checkers(args.checker, catalog)
 
-    findings = run_checkers(root, names)
+    if args.ir:
+        from heat3d_tpu.analysis.ir import run_ir_checkers
+
+        findings = run_ir_checkers(root, names)
+    else:
+        findings = run_checkers(root, names)
     baseline = load_baseline(baseline_path)
     if args.no_suppress:
         kept, suppressed = findings, []

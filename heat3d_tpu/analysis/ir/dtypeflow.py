@@ -1,0 +1,173 @@
+"""IR checker: dtype flow — round trips at the contracted boundaries,
+no silent precision drift.
+
+The precision contract (``core.config.Precision``, BASELINE.json config
+5) is exact: the field LIVES in ``storage``, every stencil application
+COMPUTES in ``compute``, the residual ACCUMULATES in ``residual`` —
+and conversions happen exactly at those boundaries, nowhere else. The
+jnp chain honors it by construction today; this family keeps it true
+through refactors by auditing the traced program:
+
+- **ANL801** — alien floating dtype: any float dtype in the program that
+  is none of storage/compute/residual. The classic producer is a silent
+  fp64 upcast from a Python float or numpy scalar riding into the chain
+  (doubling HBM traffic and halving VPU width on the next pod session).
+- **ANL802** — accumulation leak: a residual-feeding reduction
+  (``reduce_sum`` over a spatial block, or the ``psum`` itself) running
+  in a dtype below the contracted residual dtype — bf16 accumulation
+  across a 4096-cube is catastrophically lossy, and invisible in small
+  CPU tests.
+- **ANL803** — round-trip drift: with ``storage != compute`` the
+  step/superstep body must convert storage->compute and compute->storage
+  exactly once per application (k per superstep call); with equal dtypes
+  it must not convert at all. More converts = redundant HBM round trips
+  the roofline never budgeted; fewer = some application silently
+  computed (or stored) in the wrong dtype.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from heat3d_tpu.analysis.findings import ERROR, Finding
+from heat3d_tpu.analysis.ir import jaxpr_tools as jt
+
+CHECKER = "ir-dtype"
+
+
+def _finding(case, code, invariant, message) -> Finding:
+    return Finding(
+        checker=CHECKER,
+        severity=ERROR,
+        path=case.path,
+        line=0,
+        code=code,
+        symbol=f"{case.key}|{invariant}",
+        message=f"[{case.key}] {message}",
+    )
+
+
+def _contract_dtypes(case):
+    p = case.cfg.precision
+    return (
+        np.dtype(p.storage),
+        np.dtype(p.compute),
+        np.dtype(p.residual),
+    )
+
+
+def check_case(case) -> List[Finding]:
+    import jax.numpy as jnp  # noqa: F401 - registers bfloat16 with numpy
+
+    out: List[Finding] = []
+    storage, compute, residual = _contract_dtypes(case)
+    allowed = {storage, compute, residual}
+    closed = case.jaxpr()
+
+    seen_float = set()
+    for aval in jt.iter_avals(closed):
+        dt = getattr(aval, "dtype", None)
+        if dt is None:
+            continue
+        dt = np.dtype(dt)
+        if jt.is_float_dtype(dt) and dt not in allowed:
+            seen_float.add(str(dt))
+    for dt in sorted(seen_float):
+        out.append(
+            _finding(
+                case,
+                "ANL801",
+                f"alien-dtype:{dt}",
+                f"dtype {dt} appears in the traced program but the "
+                f"precision contract is storage={storage}/"
+                f"compute={compute}/residual={residual}: a silent "
+                "upcast (or downcast) leaked into the chain",
+            )
+        )
+
+    # residual accumulation dtype
+    if "residual" in case.kind:
+        for eqn in jt.iter_eqns(closed):
+            name = eqn.primitive.name
+            if name == "reduce_sum":
+                aval = eqn.invars[0].aval
+                if len(aval.shape) >= 3 and jt.is_float_dtype(
+                    aval.dtype
+                ):
+                    if np.dtype(aval.dtype) != residual:
+                        out.append(
+                            _finding(
+                                case,
+                                "ANL802",
+                                "residual-accumulate",
+                                f"residual reduce_sum accumulates in "
+                                f"{aval.dtype}, contract says {residual}:"
+                                " convert BEFORE the reduction — "
+                                "converting the reduced scalar after the "
+                                "fact keeps the lossy accumulation",
+                            )
+                        )
+            elif name == "psum":
+                for v in eqn.invars:
+                    dt = np.dtype(v.aval.dtype)
+                    if jt.is_float_dtype(dt) and dt != residual:
+                        out.append(
+                            _finding(
+                                case,
+                                "ANL802",
+                                "residual-psum-dtype",
+                                f"residual psum runs in {dt}, contract "
+                                f"says {residual}: the cross-device "
+                                "reduction itself is lossy",
+                            )
+                        )
+
+    # storage<->compute round trips, exactly at application boundaries
+    if case.kind in ("step", "superstep"):
+        up = down = 0
+        for eqn in jt.iter_eqns(closed):
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src = np.dtype(eqn.invars[0].aval.dtype)
+            dst = np.dtype(eqn.outvars[0].aval.dtype)
+            if len(eqn.outvars[0].aval.shape) < 3:
+                continue
+            if not (jt.is_float_dtype(src) and jt.is_float_dtype(dst)):
+                continue
+            if (src, dst) == (storage, compute):
+                up += 1
+            elif (src, dst) == (compute, storage):
+                down += 1
+        k = case.k
+        expect = 0 if storage == compute else k
+        if (up, down) != (expect, expect):
+            out.append(
+                _finding(
+                    case,
+                    "ANL803",
+                    "round-trip",
+                    f"storage<->compute round trips drifted: found "
+                    f"{up} up-converts / {down} down-converts of "
+                    f"field-sized arrays, contract is exactly {expect} "
+                    f"each (one per application, k={k}, "
+                    f"storage={storage}, compute={compute}): extra "
+                    "converts are unbudgeted HBM sweeps, missing ones "
+                    "mean an application ran or stored in the wrong "
+                    "dtype",
+                )
+            )
+    return out
+
+
+def check(root: str, cases: Optional[Sequence] = None) -> List[Finding]:
+    if cases is None:
+        from heat3d_tpu.analysis.ir import programs
+
+        programs.ensure_devices()
+        cases = programs.judged_matrix()
+    out: List[Finding] = []
+    for case in cases:
+        out.extend(check_case(case))
+    return out

@@ -1,0 +1,215 @@
+"""IR checker: halo-footprint dataflow — exchanged width vs true read
+footprint.
+
+PR 5's deep temporal blocking hand-derives the trapezoid invariant: a
+k-update superstep must exchange exactly ``k * r`` ghost layers (r = the
+stencil's per-axis tap radius) and consume them in shrinking rings,
+application j reading the ring application j-1 produced. This family
+machine-checks that against the traced program:
+
+- the **required** footprint is derived by abstract-interpreting the tap
+  chain at the stencil spec level: r = max |offset| per axis over the
+  nonzero taps, compounded over the k applications one superstep call
+  executes;
+- the **provided** width is read off the IR: the thickness of every
+  ppermuted face along its exchange axis, and the growth of the padded
+  slab the stencil chain consumes (covers BC-filled unsharded axes,
+  where no permute exists to measure).
+
+Findings:
+
+- **ANL701** — insufficient: provided width < k*r on some axis. The
+  outermost interior cells read ghost cells that were never exchanged —
+  silent wrong answers at shard boundaries.
+- **ANL702** — wasteful: provided width > k*r (warning): every exchange
+  ships ghost planes no tap chain ever reads — pure ICI/HBM overhead.
+- **ANL703** — trapezoid chain broken: the shrinking-ring intermediate
+  shapes (local + 2r(k-j) per axis, j = 0..k) are not all present in the
+  traced body. The superstep is not consuming its rings one application
+  at a time — the recompute accounting
+  (``parallel.step.superstep_cell_updates``) no longer describes it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from heat3d_tpu.analysis.findings import ERROR, WARNING, Finding
+from heat3d_tpu.analysis.ir import jaxpr_tools as jt
+
+CHECKER = "ir-footprint"
+
+
+def _finding(case, code, severity, invariant, message) -> Finding:
+    return Finding(
+        checker=CHECKER,
+        severity=severity,
+        path=case.path,
+        line=0,
+        code=code,
+        symbol=f"{case.key}|{invariant}",
+        message=f"[{case.key}] {message}",
+    )
+
+
+def tap_radius(cfg) -> Tuple[int, int, int]:
+    """Per-axis read radius of one stencil application, derived from the
+    nonzero taps (the abstract interpretation of the chain: one
+    application reads offsets, k applications compound them)."""
+    from heat3d_tpu.core.stencils import STENCILS
+
+    w = np.asarray(STENCILS[cfg.stencil.kind].weights)
+    nz = np.argwhere(w != 0.0) - 1  # offsets in {-1, 0, 1}
+    if nz.size == 0:
+        return (0, 0, 0)
+    return tuple(int(np.max(np.abs(nz[:, a]))) for a in range(3))
+
+
+def _body_shapes(case) -> Set[Tuple[int, ...]]:
+    """All spatial (trailing-3) shapes of >=3-d float arrays anywhere in
+    the traced program."""
+    shapes: Set[Tuple[int, ...]] = set()
+    for aval in jt.iter_avals(case.jaxpr()):
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None or len(shape) < 3:
+            continue
+        if not jt.is_float_dtype(dtype):
+            continue
+        shapes.add(tuple(shape[-3:]))
+    return shapes
+
+
+def _measured_widths(case, sites) -> List[Tuple[Tuple[int, ...], str, int]]:
+    """(loop_path, axis, width) per ppermuted face — the exchanged ghost
+    width as the IR actually ships it, grouped per dynamic exchange."""
+    axis_pos = {a: i for i, a in enumerate(case.spatial_axes)}
+    out = []
+    for s in sites:
+        if s.prim != "ppermute" or not s.in_shapes:
+            continue
+        axis = s.axes[0] if s.axes else None
+        if axis not in axis_pos:
+            continue
+        dims = tuple(s.in_shapes[0][-3:])
+        if len(dims) == 3:
+            out.append((s.loop_path, axis, dims[axis_pos[axis]]))
+    return out
+
+
+def _group_ks(case, paths: List[Tuple[int, ...]]) -> dict:
+    """Applications-per-exchange for each exchange group. Solver programs
+    run ONE exchange shape; the ensemble run program is a k-superstep
+    loop followed by a single-step remainder loop (budget % k), and its
+    residual probe is always a single step."""
+    if case.kind == "ensemble_step_residual":
+        return {p: 1 for p in paths}
+    if case.kind == "ensemble_run" and case.k > 1:
+        ordered = sorted(paths)
+        return {p: (case.k if i == 0 else 1) for i, p in enumerate(ordered)}
+    return {p: case.k for p in paths}
+
+
+def check_case(case) -> List[Finding]:
+    out: List[Finding] = []
+    r = tap_radius(case.cfg)
+    local = tuple(case.cfg.local_shape)
+    axis_pos = {a: i for i, a in enumerate(case.spatial_axes)}
+
+    sites = jt.collect_collectives(case.jaxpr())
+    measured = _measured_widths(case, sites)
+    group_k = _group_ks(case, sorted({p for p, _, _ in measured}))
+    ks = sorted(set(group_k.values()) or {case.k})
+    for path, axis, w in measured:
+        kk = group_k[path]
+        need = kk * r[axis_pos[axis]]
+        if w < need:
+            out.append(
+                _finding(
+                    case,
+                    "ANL701",
+                    ERROR,
+                    f"ghost-width:{axis}",
+                    f"exchanged ghost width {w} on axis {axis!r} < the "
+                    f"{need} layers the tap chain reads (k={kk} "
+                    f"applications x radius {r[axis_pos[axis]]}): "
+                    "boundary cells consume ghosts that were never "
+                    "exchanged",
+                )
+            )
+        elif w > need:
+            out.append(
+                _finding(
+                    case,
+                    "ANL702",
+                    WARNING,
+                    f"ghost-width:{axis}",
+                    f"exchanged ghost width {w} on axis {axis!r} > the "
+                    f"{need} layers the tap chain reads: every exchange "
+                    "ships dead ghost planes (ICI/HBM overhead, not a "
+                    "correctness bug)",
+                )
+            )
+
+    # slab growth covers every axis, BC-filled unsharded ones included
+    shapes = _body_shapes(case)
+    slab = tuple(
+        li + 2 * ri * max(ks) for li, ri in zip(local, r)
+    )
+    if case.cfg.overlap:
+        # the interior/boundary split consumes 3-thick face slices of the
+        # padded array instead of shrinking full slabs — only the padded
+        # slab itself is contracted
+        if slab not in shapes:
+            out.append(
+                _finding(
+                    case,
+                    "ANL701",
+                    ERROR,
+                    "overlap-slab",
+                    f"overlap step never materializes the width-"
+                    f"{[ri * max(ks) for ri in r]} padded slab {slab} "
+                    f"(local {local}): the boundary shell reads an "
+                    "underpadded array",
+                )
+            )
+        return out
+
+    missing = []
+    for kk in ks:
+        for j in range(kk + 1):
+            stage = tuple(
+                li + 2 * ri * (kk - j) for li, ri in zip(local, r)
+            )
+            if stage not in shapes:
+                missing.append((kk, j, stage))
+    if missing:
+        out.append(
+            _finding(
+                case,
+                "ANL703",
+                ERROR,
+                "trapezoid-chain",
+                f"shrinking-ring chain broken: (k, stage, shape) "
+                f"{missing} absent from the traced body (expected local "
+                f"{local} growing to {slab} in steps of 2x radius {r}): "
+                "the superstep does not consume its exchanged rings one "
+                "application at a time, so the recompute cost model no "
+                "longer describes this program",
+            )
+        )
+    return out
+
+
+def check(root: str, cases: Optional[Sequence] = None) -> List[Finding]:
+    if cases is None:
+        from heat3d_tpu.analysis.ir import programs
+
+        programs.ensure_devices()
+        cases = programs.judged_matrix()
+    out: List[Finding] = []
+    for case in cases:
+        out.extend(check_case(case))
+    return out

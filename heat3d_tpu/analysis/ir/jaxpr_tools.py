@@ -1,0 +1,431 @@
+"""Jaxpr-walking toolkit the IR checker families share.
+
+Two capabilities over a traced ``ClosedJaxpr``:
+
+1. **Collective collection** (:func:`collect_collectives`): every
+   communicating primitive anywhere in the program — through
+   ``shard_map``/``pjit`` bodies, ``cond`` branches, ``while``/``scan``
+   carries, custom-derivative wrappers — with its mesh axes, operand
+   shapes, and the chain of enclosing loop bodies (so a checker can
+   reason per *dynamic* exchange, not per static program).
+
+2. **Axis-taint divergence analysis** (:func:`analyze_divergence`): a
+   reimplementation of the varying-manual-axes discipline the repo turns
+   off with ``check_vma=False`` on every ``shard_map``. Each value gets
+   a taint set — the mesh axes over which its per-shard value may
+   differ: ``axis_index('x')`` introduces ``{'x'}``, a block-sharded
+   ``shard_map`` input introduces its mapped axes, ``ppermute`` adds its
+   permuted axes (neighbor data), and ``psum``/``pmax``/``pmin``/
+   ``all_gather`` *remove* their reduced axes (all members agree on the
+   result). A ``cond``/``while`` whose predicate carries taint is
+   shard-varying control flow; a collective reached under it whose axes
+   intersect the predicate's taint is the pod-deadlock hazard — within
+   one collective group, members disagree about whether the collective
+   executes. The intersection matters: a y-ring psum under a predicate
+   that varies only along x is safe (every member of a y ring shares its
+   x coordinate, so the ring agrees on the branch).
+
+This is deliberately a tripwire, not a theorem prover: ``pallas_call``
+bodies are opaque (their in-kernel DMA is certified by the interpret-tier
+parity tests instead), and unknown primitives default to
+union-of-operand-taints, which is conservative in the safe direction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+import jax
+
+_ClosedJaxpr = jax.core.ClosedJaxpr
+_Jaxpr = jax.core.Jaxpr
+
+# Primitives that communicate between mesh members — a divergent guard
+# around any of these is a deadlock, not a wrong number.
+COLLECTIVE_PRIMS = frozenset(
+    {
+        "ppermute",
+        "pbroadcast",
+        "psum",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "all_to_all",
+        "psum_scatter",
+        "reduce_scatter",
+        "pgather",
+    }
+)
+
+# Collectives whose result is identical on every member of the reduced
+# axes — they REMOVE those axes from a value's taint set.
+_UNIFORMIZING = frozenset({"psum", "pmax", "pmin", "all_gather"})
+
+
+def _sub_closed_jaxprs(eqn) -> List[Tuple[str, _ClosedJaxpr]]:
+    """(param_name, ClosedJaxpr) for every sub-program an eqn carries."""
+    out: List[Tuple[str, _ClosedJaxpr]] = []
+    for name, v in eqn.params.items():
+        if isinstance(v, _ClosedJaxpr):
+            out.append((name, v))
+        elif isinstance(v, _Jaxpr):
+            out.append((name, _ClosedJaxpr(v, ())))
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, _ClosedJaxpr):
+                    out.append((name, x))
+                elif isinstance(x, _Jaxpr):
+                    out.append((name, _ClosedJaxpr(x, ())))
+    return out
+
+
+def collective_axes(eqn) -> Tuple[str, ...]:
+    """The mesh axis NAMES a collective eqn communicates over (positional
+    int axes — impossible inside shard_map bodies — are dropped)."""
+    axes = eqn.params.get("axes", None)
+    if axes is None:
+        axes = eqn.params.get("axis_name", ())
+    if isinstance(axes, str):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+@dataclasses.dataclass
+class CollectiveSite:
+    """One collective eqn with enough context to check topology."""
+
+    prim: str  # primitive name ("ppermute", "psum", ...)
+    axes: Tuple[str, ...]  # mesh axes it communicates over
+    perm: Optional[Tuple[Tuple[int, int], ...]]  # ppermute pairs, else None
+    in_shapes: Tuple[Tuple[int, ...], ...]  # operand array shapes
+    dtypes: Tuple[str, ...]  # operand dtypes
+    loop_path: Tuple[int, ...]  # ids of enclosing while/scan bodies
+
+
+def collect_collectives(closed: _ClosedJaxpr) -> List[CollectiveSite]:
+    sites: List[CollectiveSite] = []
+    counter = [0]
+
+    def walk(jaxpr: _Jaxpr, loop_path: Tuple[int, ...]) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                shapes = []
+                dtypes = []
+                for v in eqn.invars:
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and hasattr(aval, "shape"):
+                        shapes.append(tuple(aval.shape))
+                        dtypes.append(str(getattr(aval, "dtype", "")))
+                sites.append(
+                    CollectiveSite(
+                        prim=name,
+                        axes=collective_axes(eqn),
+                        perm=tuple(map(tuple, eqn.params["perm"]))
+                        if name == "ppermute"
+                        else None,
+                        in_shapes=tuple(shapes),
+                        dtypes=tuple(dtypes),
+                        loop_path=loop_path,
+                    )
+                )
+            is_loop = name in ("while", "scan")
+            for _, sub in _sub_closed_jaxprs(eqn):
+                if is_loop:
+                    counter[0] += 1
+                    walk(sub.jaxpr, loop_path + (counter[0],))
+                else:
+                    walk(sub.jaxpr, loop_path)
+
+    walk(closed.jaxpr, ())
+    return sites
+
+
+def is_float_dtype(dt) -> bool:
+    """Floating-point test that covers the extended dtypes (bfloat16 is
+    NOT an ``np.floating`` subtype — jnp's lattice knows it is float)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        return bool(jnp.issubdtype(np.dtype(dt), jnp.floating))
+    except TypeError:
+        return False
+
+
+def iter_avals(closed: _ClosedJaxpr) -> Iterable[Any]:
+    """Every abstract value appearing anywhere in the program (invars,
+    outvars and all intermediates, sub-jaxprs included)."""
+
+    def walk(jaxpr: _Jaxpr):
+        for v in list(jaxpr.invars) + list(jaxpr.constvars):
+            if hasattr(v, "aval"):
+                yield v.aval
+        for eqn in jaxpr.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(v, "aval", None)
+                if aval is not None:
+                    yield aval
+            for _, sub in _sub_closed_jaxprs(eqn):
+                yield from walk(sub.jaxpr)
+
+    yield from walk(closed.jaxpr)
+
+
+def iter_eqns(closed: _ClosedJaxpr) -> Iterable[Any]:
+    """Every eqn in the program, sub-jaxprs included."""
+
+    def walk(jaxpr: _Jaxpr):
+        for eqn in jaxpr.eqns:
+            yield eqn
+            for _, sub in _sub_closed_jaxprs(eqn):
+                yield from walk(sub.jaxpr)
+
+    yield from walk(closed.jaxpr)
+
+
+# ---- axis-taint divergence analysis ----------------------------------------
+
+
+@dataclasses.dataclass
+class DivergentCollective:
+    """A collective reached under shard-varying control flow whose axes
+    intersect the predicate's taint — the deadlock finding."""
+
+    prim: str
+    axes: Tuple[str, ...]
+    pred_axes: Tuple[str, ...]  # the taint of the steering predicate
+    control: str  # "cond" | "while"
+
+
+@dataclasses.dataclass
+class ReplicationViolation:
+    """A shard_map output whose value varies over a mesh axis its
+    out_spec does not shard over — a "replicated" output that isn't, or
+    a partially-mapped output whose stitching is ill-defined on the
+    missing axis. The check_vma=False debt."""
+
+    taint: Tuple[str, ...]
+    out_index: int
+
+
+class _TaintInterp:
+    def __init__(self, axis_sizes: Dict[str, int]):
+        self.axis_sizes = dict(axis_sizes)
+        self.divergent: List[DivergentCollective] = []
+        self.replication: List[ReplicationViolation] = []
+
+    def _real(self, axes: Iterable[str]) -> Set[str]:
+        """Axes of size > 1 — a size-1 axis cannot vary."""
+        return {a for a in axes if self.axis_sizes.get(a, 1) > 1}
+
+    # -- core interpreter ---------------------------------------------------
+
+    def run(
+        self,
+        closed: _ClosedJaxpr,
+        in_taints: Sequence[Set[str]],
+        context: Set[str],
+    ) -> List[Set[str]]:
+        jaxpr = closed.jaxpr
+        env: Dict[Any, Set[str]] = {}
+
+        def read(v) -> Set[str]:
+            if not hasattr(v, "aval") or type(v).__name__ == "Literal":
+                return set()
+            return env.get(v, set())
+
+        def write(v, taint: Set[str]) -> None:
+            env[v] = taint
+
+        for v in jaxpr.constvars:
+            write(v, set())
+        for v, t in zip(jaxpr.invars, in_taints):
+            write(v, set(t))
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ins = [read(v) for v in eqn.invars]
+            union: Set[str] = set().union(*ins) if ins else set()
+
+            if name in COLLECTIVE_PRIMS and context:
+                hit = self._real(collective_axes(eqn)) & context
+                if hit:
+                    self.divergent.append(
+                        DivergentCollective(
+                            prim=name,
+                            axes=collective_axes(eqn),
+                            pred_axes=tuple(sorted(context)),
+                            control="cond/while",
+                        )
+                    )
+
+            if name == "axis_index":
+                out = self._real(collective_axes(eqn))
+            elif name in _UNIFORMIZING:
+                out = union - set(collective_axes(eqn))
+            elif name == "ppermute":
+                out = union | self._real(collective_axes(eqn))
+            elif name == "shard_map":
+                out_list = self._shard_map(eqn, ins, context)
+                for v, t in zip(eqn.outvars, out_list):
+                    write(v, t)
+                continue
+            elif name == "cond":
+                out_list = self._cond(eqn, ins, context)
+                for v, t in zip(eqn.outvars, out_list):
+                    write(v, t)
+                continue
+            elif name == "while":
+                out_list = self._while(eqn, ins, context)
+                for v, t in zip(eqn.outvars, out_list):
+                    write(v, t)
+                continue
+            elif name == "scan":
+                out_list = self._scan(eqn, ins, context)
+                for v, t in zip(eqn.outvars, out_list):
+                    write(v, t)
+                continue
+            else:
+                subs = _sub_closed_jaxprs(eqn)
+                if subs and name not in ("pallas_call",):
+                    # generic call-like primitive (pjit, remat, custom_*):
+                    # map operand taints positionally onto the body
+                    sub = subs[0][1]
+                    n = len(sub.jaxpr.invars)
+                    mapped = ins[-n:] if n <= len(ins) else (
+                        ins + [set()] * (n - len(ins))
+                    )
+                    out_list = self.run(sub, mapped, context)
+                    for v, t in zip(eqn.outvars, out_list):
+                        write(v, t)
+                    continue
+                out = union
+            for v in eqn.outvars:
+                write(v, out)
+
+        return [read(v) for v in jaxpr.outvars]
+
+    # -- structured primitives ---------------------------------------------
+
+    def _shard_map(self, eqn, ins, context) -> List[Set[str]]:
+        body: _Jaxpr = eqn.params["jaxpr"]
+        in_names = eqn.params.get("in_names", ())
+        out_names = eqn.params.get("out_names", ())
+        mesh = eqn.params.get("mesh")
+        if mesh is not None:
+            # Mesh and AbstractMesh both expose .shape as name -> size
+            for a, s in dict(mesh.shape).items():
+                self.axis_sizes.setdefault(a, s)
+        taints = []
+        for i, v in enumerate(body.invars):
+            names = in_names[i] if i < len(in_names) else {}
+            mapped: Set[str] = set()
+            for ax_names in getattr(names, "values", lambda: [])():
+                mapped |= self._real(
+                    ax_names if isinstance(ax_names, (tuple, list)) else (ax_names,)
+                )
+            taints.append(mapped | (ins[i] if i < len(ins) else set()))
+        out_taints = self.run(_ClosedJaxpr(body, ()), taints, context)
+        result = []
+        for i, t in enumerate(out_taints):
+            names = out_names[i] if i < len(out_names) else {}
+            gathered: Set[str] = set()
+            for ax_names in getattr(names, "values", lambda: [])():
+                gathered |= set(
+                    ax_names if isinstance(ax_names, (tuple, list)) else (ax_names,)
+                )
+            residual = t - gathered
+            if residual:
+                # the value varies over an axis the out_spec does NOT
+                # shard over: fully-unmapped = a "replicated" output
+                # that isn't; partially-mapped = the stitched global
+                # array is ill-defined on the missing axis (which
+                # shard's value wins is undefined) — both are the
+                # check_vma=False unsoundness class
+                self.replication.append(
+                    ReplicationViolation(
+                        taint=tuple(sorted(residual)), out_index=i
+                    )
+                )
+            # from the caller's side the stitched global array is one
+            # value; a flagged residual is already surfaced above
+            result.append(set())
+        return result
+
+    def _cond(self, eqn, ins, context) -> List[Set[str]]:
+        pred = ins[0] if ins else set()
+        ctx = context | pred
+        branches = [
+            s for n, s in _sub_closed_jaxprs(eqn) if n == "branches"
+        ]
+        outs: Optional[List[Set[str]]] = None
+        for br in branches:
+            o = self.run(br, ins[1:], ctx if pred else context)
+            outs = o if outs is None else [a | b for a, b in zip(outs, o)]
+        outs = outs or []
+        # a divergent predicate makes every output shard-varying
+        return [o | pred for o in outs]
+
+    def _while(self, eqn, ins, context) -> List[Set[str]]:
+        cond_n = eqn.params["cond_nconsts"]
+        body_n = eqn.params["body_nconsts"]
+        cond_j = eqn.params["cond_jaxpr"]
+        body_j = eqn.params["body_jaxpr"]
+        cond_consts = ins[:cond_n]
+        body_consts = ins[cond_n : cond_n + body_n]
+        carry = [set(t) for t in ins[cond_n + body_n :]]
+        # fixpoint on the carry taint (monotone over a finite lattice)
+        for _ in range(len(carry) + len(self.axis_sizes) + 2):
+            new = self.run(body_j, body_consts + carry, context)
+            merged = [a | b for a, b in zip(carry, new)]
+            if merged == carry:
+                break
+            carry = merged
+        pred = self.run(cond_j, cond_consts + carry, context)
+        pred_taint: Set[str] = set().union(*pred) if pred else set()
+        ctx = context | pred_taint
+        # re-walk the body under the (possibly divergent) predicate
+        # context so collectives inside are judged against it
+        self.run(body_j, body_consts + carry, ctx)
+        return [c | pred_taint for c in carry]
+
+    def _scan(self, eqn, ins, context) -> List[Set[str]]:
+        # static trip count: the loop structure itself cannot diverge
+        body = eqn.params["jaxpr"]
+        n_consts = eqn.params["num_consts"]
+        n_carry = eqn.params["num_carry"]
+        consts = ins[:n_consts]
+        carry = [set(t) for t in ins[n_consts : n_consts + n_carry]]
+        xs = ins[n_consts + n_carry :]
+        outs: List[Set[str]] = []
+        for _ in range(n_carry + len(self.axis_sizes) + 2):
+            outs = self.run(body, consts + carry + xs, context)
+            merged = [a | b for a, b in zip(carry, outs[:n_carry])]
+            if merged == carry:
+                break
+            carry = merged
+        return carry + outs[n_carry:]
+
+
+def analyze_divergence(
+    closed: _ClosedJaxpr, axis_sizes: Optional[Dict[str, int]] = None
+) -> Tuple[List[DivergentCollective], List[ReplicationViolation]]:
+    """Run the taint interpreter over a traced program. Entry arguments
+    are uniform (every process passes the same global arrays); shard
+    variation enters through shard_map in_names and axis_index."""
+    interp = _TaintInterp(axis_sizes or {})
+    interp.run(closed, [set() for _ in closed.jaxpr.invars], set())
+
+    def _dedupe(items):
+        seen, out = set(), []
+        for it in items:
+            key = dataclasses.astuple(it)
+            if key not in seen:
+                seen.add(key)
+                out.append(it)
+        return out
+
+    # fixpoint iteration re-walks loop bodies, so findings repeat
+    return _dedupe(interp.divergent), _dedupe(interp.replication)

@@ -1,0 +1,328 @@
+"""The judged program matrix the IR checkers certify.
+
+Every case is a REAL program the production code builds —
+``make_step_fn`` / ``make_superstep_fn`` (with and without the residual
+psum) and the ``EnsembleSolver`` traced-bind executables — traced to a
+closed jaxpr over a multi-device CPU mesh. Validity pruning reuses
+``tune.space.enumerate_candidates`` (which builds the real solver and
+raises the production error message), so the matrix can never drift from
+what the framework actually accepts.
+
+Device posture: the IR lint wants >= 4 host devices so the judged meshes
+((2,2,1), (4,1,1), the b=2 x (2,1,1) ensemble hybrid) and their
+collectives are real. :func:`ensure_devices` forces
+``--xla_force_host_platform_device_count`` through ``XLA_FLAGS``
+(``HEAT3D_IR_DEVICES``, default 4) — but only when the jax backend has
+not initialized yet; a session that already booted single-device gets a
+degraded single-shard matrix and the runner surfaces that as a warning
+finding instead of silently certifying nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+ENV_DEVICES = "HEAT3D_IR_DEVICES"
+ENV_COMPILE = "HEAT3D_IR_COMPILE"
+
+# grid edge for the judged matrix: small enough to trace in milliseconds,
+# large enough that local extents admit tb up to 4 on every judged mesh
+_GRID = 16
+_GRID_UNEVEN = 18  # not divisible by 4 -> exercises the padded-shard pins
+
+
+def wanted_devices() -> int:
+    """The device count the full judged matrix needs (the (2,2,1) /
+    (4,1,1) meshes and the ensemble hybrid all factor into 4)."""
+    return int(os.environ.get(ENV_DEVICES, "4") or 4)
+
+
+def ensure_devices() -> int:
+    """Force a multi-device CPU backend for the judged meshes when still
+    possible; returns the visible device count either way."""
+    import jax
+
+    want = wanted_devices()
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # noqa: BLE001 - private API; assume the worst
+        initialized = True
+    if not initialized and want > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}"
+            ).strip()
+    return len(jax.devices())
+
+
+def compile_enabled() -> bool:
+    """``HEAT3D_IR_COMPILE=0`` skips the compiled memory-contract leg
+    (trace-only lint — e.g. a laptop run that only wants the jaxpr
+    families)."""
+    return os.environ.get(ENV_COMPILE, "1").lower() not in ("0", "false")
+
+
+@dataclasses.dataclass
+class ProgramCase:
+    """One traced program under certification.
+
+    ``key`` is the config-key half of every finding fingerprint —
+    checkers anchor findings on ``(checker, key, invariant)``, never on
+    jaxpr pretty-printer text, so baselines survive jax upgrades."""
+
+    key: str
+    cfg: Any  # SolverConfig
+    kind: str  # step | superstep | residual | ensemble_run | ensemble_residual
+    path: str  # repo-relative builder module (finding location)
+    fn: Any = None
+    avals: Tuple[Any, ...] = ()
+    compile: bool = False  # memory-contract leg compiles this case
+    spatial_axes: Tuple[str, ...] = ("x", "y", "z")
+    batch_axes: Tuple[str, ...] = ()
+    mesh_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    _jaxpr: Any = None
+
+    @property
+    def k(self) -> int:
+        return max(1, self.cfg.time_blocking)
+
+    def jaxpr(self):
+        if self._jaxpr is None:
+            import jax
+
+            self._jaxpr = jax.make_jaxpr(self.fn)(*self.avals)
+        return self._jaxpr
+
+    def compiled(self):
+        import jax
+
+        return jax.jit(self.fn).lower(*self.avals).compile()
+
+
+def _case_key(cfg, kind: str) -> str:
+    mesh = "x".join(str(p) for p in cfg.mesh.shape)
+    dt = "bf16" if cfg.precision.storage == "bfloat16" else "fp32"
+    bits = [
+        cfg.stencil.kind,
+        dt,
+        f"g{cfg.grid.shape[0]}",
+        f"m{mesh}",
+        f"tb{cfg.time_blocking}",
+        cfg.halo_order,
+    ]
+    if cfg.overlap:
+        bits.append("overlap")
+    bits.append(kind)
+    return "/".join(bits)
+
+
+def _solver_cases(
+    base, space: Dict[str, Sequence[Any]], compile_keys: Sequence[str]
+) -> List[ProgramCase]:
+    """Expand one base config over ``space`` with the tuner's production
+    validity pruning, building a traced case per surviving candidate."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat3d_tpu.parallel.step import make_step_fn, make_superstep_fn
+    from heat3d_tpu.parallel.topology import build_mesh
+    from heat3d_tpu.tune.space import enumerate_candidates
+
+    cases: List[ProgramCase] = []
+    seen: set = set()
+    for cand in enumerate_candidates(base, space, validate=True):
+        if cand.prune is not None or cand.cfg is None or cand.cfg in seen:
+            continue
+        seen.add(cand.cfg)
+        cfg = cand.cfg
+        mesh = build_mesh(cfg.mesh)
+        aval = jax.ShapeDtypeStruct(
+            cfg.padded_shape, jnp.dtype(cfg.precision.storage)
+        )
+        mesh_sizes = dict(zip(cfg.mesh.axis_names, cfg.mesh.shape))
+        kind = "superstep" if cfg.time_blocking > 1 else "step"
+        builder = (
+            make_superstep_fn(cfg, mesh)
+            if cfg.time_blocking > 1
+            else make_step_fn(cfg, mesh)
+        )
+        key = _case_key(cfg, kind)
+        cases.append(
+            ProgramCase(
+                key=key,
+                cfg=cfg,
+                kind=kind,
+                path="heat3d_tpu/parallel/step.py",
+                fn=builder,
+                avals=(aval,),
+                compile=key in compile_keys,
+                spatial_axes=cfg.mesh.axis_names,
+                mesh_sizes=mesh_sizes,
+            )
+        )
+        if cfg.time_blocking == 1 and not cfg.overlap:
+            rkey = _case_key(cfg, "residual")
+            cases.append(
+                ProgramCase(
+                    key=rkey,
+                    cfg=cfg,
+                    kind="residual",
+                    path="heat3d_tpu/parallel/step.py",
+                    fn=make_step_fn(cfg, mesh, with_residual=True),
+                    avals=(aval,),
+                    compile=rkey in compile_keys,
+                    spatial_axes=cfg.mesh.axis_names,
+                    mesh_sizes=mesh_sizes,
+                )
+            )
+    return cases
+
+
+def _ensemble_cases(num_devices: int) -> List[ProgramCase]:
+    """The traced-bind EnsembleSolver executables: the pure-spatial
+    factorization and the hybrid batch x space mesh (halo collectives
+    must stay on the spatial axes)."""
+    if num_devices < 4:
+        return []
+    from heat3d_tpu.core.config import (
+        GridConfig,
+        MeshConfig,
+        SolverConfig,
+    )
+    from heat3d_tpu.serve.ensemble import BATCH_AXIS, EnsembleSolver
+    from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+
+    cases: List[ProgramCase] = []
+    members = [
+        Scenario(alpha=0.3, bc_value=1.0, steps=5),
+        Scenario(alpha=0.5, steps=7),
+    ]
+    for label, mesh_shape, batch_mesh in (
+        ("b1xm2x2x1", (2, 2, 1), 1),
+        ("b2xm2x1x1", (2, 1, 1), 2),
+    ):
+        base = SolverConfig(
+            grid=GridConfig.cube(_GRID),
+            mesh=MeshConfig(shape=mesh_shape),
+            backend="jnp",
+            time_blocking=2,
+        )
+        es = EnsembleSolver(
+            ScenarioBatch(base, members), batch_mesh=batch_mesh
+        )
+        mesh_sizes = {BATCH_AXIS: batch_mesh}
+        mesh_sizes.update(zip(base.mesh.axis_names, mesh_shape))
+        for name, fn, args in es.ir_programs():
+            cases.append(
+                ProgramCase(
+                    key=f"ensemble/{label}/tb{es.cfg.time_blocking}/{name}",
+                    cfg=es.cfg,
+                    kind=f"ensemble_{name}",
+                    path="heat3d_tpu/serve/ensemble.py",
+                    fn=fn,
+                    avals=tuple(args),
+                    spatial_axes=es.cfg.mesh.axis_names,
+                    batch_axes=(BATCH_AXIS,),
+                    mesh_sizes=mesh_sizes,
+                )
+            )
+    return cases
+
+
+def judged_matrix(num_devices: Optional[int] = None) -> List[ProgramCase]:
+    """The full certification matrix for the current device posture."""
+    import jax
+
+    from heat3d_tpu.core.config import (
+        GridConfig,
+        MeshConfig,
+        Precision,
+        SolverConfig,
+        StencilConfig,
+    )
+
+    n = len(jax.devices()) if num_devices is None else num_devices
+    if n >= 4:
+        meshes = [(2, 2, 1), (4, 1, 1)]
+    elif n >= 2:
+        meshes = [(2, 1, 1)]
+    else:
+        meshes = [(1, 1, 1)]
+
+    # The compiled (memory-contract) subset: one representative per
+    # structural family — exchange step, deep-tb superstep, corner-reading
+    # stencil, mixed precision, residual reduction.
+    compile_keys = {
+        _case_key(c, k)
+        for c, k in _compile_targets(meshes[0])
+    }
+
+    cases: List[ProgramCase] = []
+    for mesh_shape in meshes:
+        mesh = MeshConfig(shape=mesh_shape)
+        base7 = SolverConfig(
+            grid=GridConfig.cube(_GRID), mesh=mesh, backend="jnp"
+        )
+        base27 = dataclasses.replace(base7, stencil=StencilConfig("27pt"))
+        base_bf16 = dataclasses.replace(base7, precision=Precision.bf16())
+        cases += _solver_cases(
+            base7,
+            {
+                "time_blocking": (1, 2, 3, 4),
+                "halo_order": ("axis", "pairwise"),
+                "overlap": (False, True),
+            },
+            compile_keys,
+        )
+        cases += _solver_cases(
+            base27, {"time_blocking": (1, 2, 3)}, compile_keys
+        )
+        cases += _solver_cases(
+            base_bf16, {"time_blocking": (1, 2)}, compile_keys
+        )
+    # one uneven decomposition: storage padding + bc-pin masks in the IR
+    if n >= 4:
+        cases += _solver_cases(
+            SolverConfig(
+                grid=GridConfig.cube(_GRID_UNEVEN),
+                mesh=MeshConfig(shape=(4, 1, 1)),
+                backend="jnp",
+            ),
+            {"time_blocking": (1, 3)},
+            compile_keys,
+        )
+    cases += _ensemble_cases(n)
+    return cases
+
+
+def _compile_targets(mesh_shape) -> List[Tuple[Any, str]]:
+    from heat3d_tpu.core.config import (
+        GridConfig,
+        MeshConfig,
+        Precision,
+        SolverConfig,
+        StencilConfig,
+    )
+
+    mesh = MeshConfig(shape=mesh_shape)
+    base = SolverConfig(grid=GridConfig.cube(_GRID), mesh=mesh, backend="jnp")
+    return [
+        (base, "step"),
+        (base, "residual"),
+        (dataclasses.replace(base, time_blocking=3), "superstep"),
+        (
+            dataclasses.replace(base, stencil=StencilConfig("27pt")),
+            "step",
+        ),
+        (
+            dataclasses.replace(
+                base, precision=Precision.bf16(), time_blocking=2
+            ),
+            "superstep",
+        ),
+    ]

@@ -129,6 +129,13 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                         "desc": "no entry for this context (static fallback)"},
     "tune_cache_stale": {"kind": "point", "module": "tune/cache.py",
                          "desc": "entry rejected: jax/schema/env mismatch"},
+    # IR lint (heat3d lint --ir)
+    "ir_lint_start": {"kind": "point", "module": "analysis/ir/__init__.py",
+                      "desc": "IR verifier opened: families, judged-"
+                              "matrix case count, device posture"},
+    "ir_lint_verdict": {"kind": "point", "module": "analysis/ir/__init__.py",
+                        "desc": "IR verifier verdict: per-severity "
+                                "finding counts per family set"},
     # serving (batched scenario engine)
     "serve_submit": {"kind": "point", "module": "serve/queue.py",
                      "desc": "scenario request enqueued (request_id, depth)"},
@@ -194,7 +201,7 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
     "HEAT3D_DIRECT_FORCE": {"module": "parallel/step.py",
                             "desc": "1 selects real Mosaic kernels off-TPU (compile-only tests)"},
     "HEAT3D_VMEM_BYTES": {"module": "ops/stencil_dma_fused.py",
-                          "desc": "whole-chip VMEM ceiling for the fused-DMA gate (default 32 MiB)"},
+                          "desc": "whole-chip VMEM ceiling override for the fused-DMA gate (default: per-generation table, 32 MiB unknown parts)"},
     "HEAT3D_FAULTS": {"module": "resilience/faults.py",
                       "desc": "deterministic fault-injection plan"},
     "HEAT3D_FAULT_STATE": {"module": "resilience/faults.py",
@@ -233,6 +240,13 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
     "HEAT3D_SERVE_MAX_BATCH": {"module": "serve/queue.py",
                                "desc": "members per packed batch cap "
                                        "(default 64)"},
+    "HEAT3D_IR_DEVICES": {"module": "analysis/ir/programs.py",
+                          "desc": "host-device count the IR lint forces "
+                                  "for the judged meshes (default 4; "
+                                  "only before jax initializes)"},
+    "HEAT3D_IR_COMPILE": {"module": "analysis/ir/programs.py",
+                          "desc": "0 skips the compiled memory-contract "
+                                  "leg of heat3d lint --ir"},
     "HEAT3D_SLO_SPEC": {"module": "obs/perf/slo.py",
                         "desc": "SLO objective-spec path (obs slo / "
                                 "serve --slo default)"},

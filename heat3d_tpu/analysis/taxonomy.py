@@ -128,8 +128,12 @@ def check(
             )
             # the analysis package and registry NAME events/vars without
             # emitting them; scanning them would count every registry
-            # entry as emitted
+            # entry as emitted. The IR verifier subpackage is the
+            # exception: it genuinely emits ir_lint_* and reads
+            # HEAT3D_IR_* (it is production tooling, not a checker-of-
+            # names), so it stays in the scan.
             if os.sep + "analysis" + os.sep not in p
+            or os.sep + os.path.join("analysis", "ir") + os.sep in p
         ]
         script_files = [
             os.path.join(root, "scripts", fn)

@@ -36,22 +36,27 @@ import ast
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from heat3d_tpu.analysis import astutil
-from heat3d_tpu.analysis.findings import ERROR, INFO, WARNING, Finding
+from heat3d_tpu.analysis.findings import ERROR, INFO, Finding
 
 CHECKER = "vmem-budget"
 
 MIB = 1024 * 1024
 
-# Per-generation VMEM capacity (bytes/core). Keys are normalized chip
-# generations as the tuning cache spells them. v5p-class parts carry the
-# larger VMEM the fused-DMA default budget assumes; the lite parts are
-# the ~16 MiB/core the Pallas guide documents.
-CHIP_VMEM_BYTES: Dict[str, int] = {
-    "tpu-v4": 16 * MIB,
-    "tpu-v5-lite": 16 * MIB,
-    "tpu-v5p": 32 * MIB,
-    "tpu-v6-lite": 32 * MIB,
-}
+
+def __getattr__(name: str):
+    """Per-generation VMEM capacity (bytes/core), keys spelled as the
+    tuning cache normalizes them. The table itself lives in
+    ops/stencil_dma_fused.py since PR 9: the fused-DMA gate resolves its
+    whole-chip budget from it per live generation, so the checker audits
+    the SAME numbers the production gate uses (one source, no drift).
+    Resolved lazily (PEP 562) because importing ops pulls jax — this
+    module must stay cheap to import for `heat3d lint --list` and the
+    pure-AST leg."""
+    if name == "CHIP_VMEM_BYTES":
+        from heat3d_tpu.ops.stencil_dma_fused import CHIP_VMEM_BYTES
+
+        return CHIP_VMEM_BYTES
+    raise AttributeError(name)
 
 # Mosaic's default scoped-vmem pool (the tap-chain stack lives here — a
 # separate pool from the explicit ring/pipeline buffers).
@@ -152,7 +157,6 @@ def _budget_findings(
 ) -> List[Finding]:
     """Drive the real estimator modules (imported, not parsed — the
     arithmetic IS the artifact under audit)."""
-    from heat3d_tpu.ops import stencil_dma_fused as dma
     from heat3d_tpu.ops import stencil_pallas as sp
     from heat3d_tpu.ops import stencil_pallas_direct as spd
 
@@ -204,28 +208,13 @@ def _budget_findings(
                 ),
             )
         )
-    # the fused-DMA combined gate defaults to a v5p-class whole-chip
-    # ceiling; smaller generations need the documented env override
-    chip_budget = dma._chip_vmem_budget()
-    small = [g for g, cap in chip_table.items() if chip_budget > cap]
-    if small:
-        findings.append(
-            Finding(
-                checker=CHECKER,
-                severity=WARNING,
-                path="heat3d_tpu/ops/stencil_dma_fused.py",
-                line=0,
-                code="ANL305",
-                symbol="_chip_vmem_budget",
-                message=(
-                    f"fused-DMA whole-chip budget "
-                    f"({chip_budget / MIB:.0f} MiB) exceeds the VMEM of "
-                    f"{', '.join(sorted(small))} — runs there must set "
-                    "HEAT3D_VMEM_BYTES or the combined gate admits "
-                    "unallocatable kernels (documented operator knob)"
-                ),
-            )
-        )
+    # The old standing ANL305 warning (fused-DMA 32 MiB default vs
+    # 16 MiB parts) is resolved since PR 9: the gate resolves its
+    # whole-chip ceiling per generation from THIS table
+    # (ops/stencil_dma_fused.chip_vmem_budget_for). The gate-side
+    # adjudication — resolved budget vs capacity, including the live
+    # HEAT3D_VMEM_BYTES override — now lives in the IR memory-contract
+    # checker (ANL905, analysis/ir/memcontract.py), not here.
 
     # admitted worst-case footprints over the judged shapes: anything the
     # gates admit must fit the floor generation, with headroom reported
@@ -308,9 +297,13 @@ def check(
     )
     findings = _ast_findings(root, paths)
     if arithmetic and files is None:
-        findings.extend(
-            _budget_findings(chip_table or CHIP_VMEM_BYTES, margin)
-        )
+        if chip_table is None:
+            # module __getattr__ resolves the canonical ops-owned table
+            # lazily; plain global lookup would bypass it
+            from heat3d_tpu.analysis import vmem as _self
+
+            chip_table = _self.CHIP_VMEM_BYTES
+        findings.extend(_budget_findings(chip_table, margin))
     elif arithmetic and chip_table is not None:
         findings.extend(_budget_findings(chip_table, margin))
     return findings

@@ -65,17 +65,51 @@ from heat3d_tpu.ops.stencil_pallas_direct import (
 # well inside the chip's (ghosts are 4 MB each at 1024^2 fp32).
 _GHOST_BUDGET = 16 * 1024 * 1024
 
+# Per-generation VMEM capacity (bytes/core), keyed by the normalized
+# chip-generation strings the tuning cache derives from device_kind
+# (tune.cache.chip_generation). THE single source: the vmem-budget lint
+# (analysis/vmem.py) audits the kernel admit budgets against this same
+# table, and the IR memory-contract checker adjudicates the resolved
+# fused-DMA budget against it per generation.
+CHIP_VMEM_BYTES = {
+    "tpu-v4": 16 * 1024 * 1024,
+    "tpu-v5-lite": 16 * 1024 * 1024,
+    "tpu-v5p": 32 * 1024 * 1024,
+    "tpu-v6-lite": 32 * 1024 * 1024,
+}
+
+# Unknown generations (and CPU, where the kernel routes never dispatch)
+# assume the v5p-class ceiling the pod route targets.
+_DEFAULT_VMEM_BYTES = 32 * 1024 * 1024
+
+
+def chip_vmem_budget_for(generation: str) -> int:
+    """The whole-chip VMEM ceiling the fused gate uses on ``generation``
+    (a normalized ``tune.cache.chip_generation`` string) absent an env
+    override."""
+    return CHIP_VMEM_BYTES.get(generation, _DEFAULT_VMEM_BYTES)
+
 
 def _chip_vmem_budget() -> int:
     """Whole-chip VMEM ceiling the COMBINED fused-kernel footprint (resident
     ghosts + ring/pipeline + emit-chain scoped stack) is gated against.
-    Default 32 MiB — the v5p-class chips the pod route targets; on a
-    smaller-VMEM generation set HEAT3D_VMEM_BYTES so the gate rejects (and
-    dispatch falls back to faces-direct) instead of failing Mosaic
-    allocation at compile time."""
+    Resolution order: ``HEAT3D_VMEM_BYTES`` (operator override) >
+    the per-generation table above keyed on the live chip generation >
+    the 32 MiB v5p-class default. A 16 MiB part therefore gates at its
+    real capacity out of the box — the gate rejects (and dispatch falls
+    back to faces-direct) instead of failing Mosaic allocation at
+    compile time."""
     import os
 
-    return int(os.environ.get("HEAT3D_VMEM_BYTES", 32 * 1024 * 1024))
+    env = os.environ.get("HEAT3D_VMEM_BYTES")
+    if env:
+        return int(env)
+    try:
+        from heat3d_tpu.tune.cache import chip_generation
+
+        return chip_vmem_budget_for(chip_generation())
+    except Exception:  # noqa: BLE001 - gate must resolve even wedged
+        return _DEFAULT_VMEM_BYTES
 
 
 def _fused_choose_chunk(

@@ -26,13 +26,19 @@ from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
 from heat3d_tpu.obs.trace import named_phase
 
 
-def _shift_perm(n: int, direction: int, periodic: bool):
+def shift_perm(n: int, direction: int, periodic: bool):
     """Permutation (source, dest) pairs shifting data one step along a ring
     of size n. ``direction=+1``: device i's slab goes to device i+1 (so the
     receiver sees its *low*-side neighbor's face). Non-periodic drops the
     wrap pair; undelivered ppermute outputs are zero-filled, which is the
     Dirichlet-0 ghost for free (nonzero BC values are patched by the
-    caller)."""
+    caller).
+
+    Public because it IS the mesh neighbor graph: the IR collective-
+    topology checker (``heat3d lint --ir``, analysis/ir/collectives.py)
+    proves every traced ppermute permutation equals one of these shifts
+    — verifying the compiled exchange against the same source of truth
+    the exchange is built from."""
     if periodic:
         return [(i, (i + direction) % n) for i in range(n)]
     if direction > 0:
@@ -91,11 +97,11 @@ def axis_ghosts(
         )
     # my low ghost = low neighbor's high face: shift high faces "up" (+1)
     ghost_lo = lax.ppermute(
-        hi_face, axis_name, _shift_perm(axis_size, +1, periodic)
+        hi_face, axis_name, shift_perm(axis_size, +1, periodic)
     )
     # my high ghost = high neighbor's low face: shift low faces "down" (-1)
     ghost_hi = lax.ppermute(
-        lo_face, axis_name, _shift_perm(axis_size, -1, periodic)
+        lo_face, axis_name, shift_perm(axis_size, -1, periodic)
     )
     # bc_value may be a TRACED scalar (the batched ensemble path threads a
     # per-member boundary value through vmap — serve/ensemble.py); the

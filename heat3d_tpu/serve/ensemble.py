@@ -434,6 +434,29 @@ class EnsembleSolver:
             lambda *xs: jnp.stack(xs), out_shardings=self.sharding
         )
 
+    def ir_programs(self):
+        """The traced-bind executables as ``(name, fn, example_args)``
+        triples for the IR verifier (``heat3d lint --ir``,
+        analysis/ir/programs.py): the run program (masked superstep +
+        remainder loops under SPMD-uniform pmax bounds) and the residual
+        probe. Abstract args only — nothing executes; the verifier
+        traces these to closed jaxprs and certifies the collective
+        topology / footprint / dtype flow the queue actually serves.
+        Baked binding dispatches the solo executables, which the solver
+        matrix already certifies — only the traced binding has an
+        ensemble-specific program to verify."""
+        if self.bind != "traced":
+            return []
+        u = jax.ShapeDtypeStruct(
+            (self.B,) + tuple(self.cfg.padded_shape), self.storage_dtype
+        )
+        W, C, BCV = self._coef_args()
+        budgets = jax.ShapeDtypeStruct((self.B,), jnp.int32)
+        return [
+            ("run", self._run_p, (u, W, C, BCV, budgets)),
+            ("step_residual", self._step_res_p, (u, W, C, BCV)),
+        ]
+
     # ---- stepping ---------------------------------------------------------
 
     def _budget_host(self, steps: Union[int, Sequence[int], None]):

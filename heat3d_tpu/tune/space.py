@@ -13,6 +13,12 @@ scope) raises the same ``ValueError`` it would raise in production, and
 the candidate is pruned with that exact message instead of burning
 measurement time. Solver construction builds jit WRAPPERS only (no
 trace, no compile), so pruning costs milliseconds per candidate.
+
+Two consumers share this pruning so their config universes cannot
+drift: the measurement driver (:mod:`heat3d_tpu.tune.measure`) and the
+IR verifier's judged matrix (:mod:`heat3d_tpu.analysis.ir.programs`,
+``heat3d lint --ir``) — a config the tuner would measure is exactly a
+config the verifier certifies, with the same validity rules.
 """
 
 from __future__ import annotations

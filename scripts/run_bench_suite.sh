@@ -248,6 +248,17 @@ if [[ -z "${SKIP_STATIC_LINT:-}" ]]; then
 else
   note "suite: static lint skipped (SKIP_STATIC_LINT=1)"
 fi
+# IR-tier certification gate (docs/ANALYSIS.md "IR tier"): trace the
+# judged step/superstep/ensemble matrix in a fresh process (so the
+# multi-device CPU mesh can be forced) and certify collective topology,
+# halo footprint, dtype flow and the compiled memory contract at the
+# jaxpr/HLO level. Same rc policy as the static lint; its rc is the
+# suite's rc. SKIP_IR_LINT=1 is the escape hatch.
+if [[ -z "${SKIP_IR_LINT:-}" ]]; then
+  python -m heat3d_tpu.cli lint --ir --json | tee -a "$SUITE_LOG"
+else
+  note "suite: IR lint skipped (SKIP_IR_LINT=1)"
+fi
 python -m heat3d_tpu.obs.cli regress "$OUT" --start-line "$LINT_FROM" \
   --json | tee -a "$SUITE_LOG"
 
