@@ -42,12 +42,39 @@ def run_ir_checkers(root: str, names: List[str]) -> List[Finding]:
     error finding, never a silent green. Emits the ``ir_lint_start`` /
     ``ir_lint_verdict`` ledger events (fail-soft NullLedger when no
     ledger is active)."""
+    findings: List[Finding] = []
+    # The judged matrix's partitioned programs must carry GENUINE
+    # sub-block permutes: FORCE the plan partition granularity floor to
+    # zero for the whole verifier pass (the 16^3 judged faces would
+    # otherwise ship whole — an operator's exported
+    # HEAT3D_PLAN_PART_MIN_BYTES must not let the partition invariants
+    # certify a degenerate schedule), and restore it afterwards so an
+    # in-process caller's later plans keep the real floor (tracing is
+    # lazy: the env must hold through the family loop, not just the
+    # matrix build; plan cache keys include the floor, so no stale plan
+    # can cross the restore).
+    import os
+
+    _FLOOR = "HEAT3D_PLAN_PART_MIN_BYTES"
+    saved_floor = os.environ.get(_FLOOR)
+    os.environ[_FLOOR] = "0"
+    try:
+        return _run_ir_checkers(root, names, findings)
+    finally:
+        if saved_floor is None:
+            os.environ.pop(_FLOOR, None)
+        else:
+            os.environ[_FLOOR] = saved_floor
+
+
+def _run_ir_checkers(
+    root: str, names: List[str], findings: List[Finding]
+) -> List[Finding]:
     import importlib
 
     from heat3d_tpu import obs
     from heat3d_tpu.analysis.ir import programs
 
-    findings: List[Finding] = []
     devices = None
     cases = None
     try:

@@ -126,9 +126,22 @@ def _spatial_dims(case, shape: Tuple[int, ...]) -> Tuple[int, ...]:
     return tuple(shape[-3:]) if len(shape) >= 3 else shape
 
 
+def _plan_mode(case) -> str:
+    return getattr(case.cfg, "halo_plan", "monolithic")
+
+
 def _check_exchange_groups(case, sites, out: List[Finding]):
     """Pair/completeness checks per dynamic exchange (grouped by the
-    innermost loop body: one superstep call = one group)."""
+    innermost loop body: one superstep call = one group).
+
+    Partition-aware: a ``halo_plan='partitioned'`` program ships each
+    face as N sub-block permutes, so an axis legally carries 2N
+    ppermutes — but they must fall into exactly TWO permutation classes
+    (the low-face and high-face ring shifts), the classes must be exact
+    inverse sets, and the directions must be balanced (a sub-block sent
+    and never returned is the same deadlock as a missing face). A
+    monolithic program still requires exactly one permute per
+    direction."""
     groups: Dict[Tuple[int, ...], List[jt.CollectiveSite]] = {}
     for s in sites:
         if s.prim == "ppermute":
@@ -138,13 +151,15 @@ def _check_exchange_groups(case, sites, out: List[Finding]):
         for a in case.spatial_axes
         if case.mesh_sizes.get(a, 1) > 1
     ]
+    partitioned = _plan_mode(case) == "partitioned"
     for path, group in groups.items():
         by_axis: Dict[str, List[jt.CollectiveSite]] = {}
         for s in group:
             for a in s.axes:
                 by_axis.setdefault(a, []).append(s)
         for a in sharded:
-            n = len(by_axis.get(a, []))
+            ax_sites = by_axis.get(a, [])
+            n = len(ax_sites)
             if n == 0:
                 out.append(
                     _finding(
@@ -157,78 +172,181 @@ def _check_exchange_groups(case, sites, out: List[Finding]):
                         "desynchronized halo topology",
                     )
                 )
-            elif n != 2:
+                continue
+            classes: Dict[frozenset, List[jt.CollectiveSite]] = {}
+            for s in ax_sites:
+                classes.setdefault(frozenset(s.perm or ()), []).append(s)
+            if len(classes) == 2:
+                (p0, s0), (p1, s1) = list(classes.items())
+                if frozenset((d, src) for src, d in p0) != p1:
+                    out.append(
+                        _finding(
+                            case,
+                            "ANL603",
+                            f"inverse-pair:{a}",
+                            f"the permutation classes over axis {a!r} are "
+                            f"not inverse sets ({sorted(p0)} vs "
+                            f"{sorted(p1)}): opposite faces must be "
+                            "matched send/recv pairs or a boundary rank "
+                            "deadlocks waiting for the return leg",
+                        )
+                    )
+                if len(s0) != len(s1):
+                    out.append(
+                        _finding(
+                            case,
+                            "ANL605",
+                            f"pair-count:{a}:loop{len(path)}",
+                            f"exchange group (loop depth {len(path)}) "
+                            f"ships {len(s0)} low-face vs {len(s1)} "
+                            f"high-face permutes over axis {a!r}: the "
+                            "directions must be balanced — a sub-block "
+                            "sent one way and never returned is an "
+                            "unmatched transfer",
+                        )
+                    )
+                elif len(s0) != 1 and not partitioned:
+                    out.append(
+                        _finding(
+                            case,
+                            "ANL605",
+                            f"pair-count:{a}:loop{len(path)}",
+                            f"exchange group (loop depth {len(path)}) "
+                            f"carries {n} ppermutes over axis {a!r} on a "
+                            "MONOLITHIC plan; a width-k exchange is "
+                            "exactly one low-face and one high-face "
+                            "permute per superstep call (sub-block "
+                            "multiplicity is the partitioned plan's "
+                            "contract)",
+                        )
+                    )
+            elif len(classes) == 1:
+                perm = next(iter(classes))
+                self_inverse = (
+                    frozenset((d, src) for src, d in perm) == perm
+                )
+                if not self_inverse or n % 2:
+                    out.append(
+                        _finding(
+                            case,
+                            "ANL605",
+                            f"pair-count:{a}:loop{len(path)}",
+                            f"exchange group (loop depth {len(path)}) "
+                            f"carries {n} ppermute(s) over axis {a!r} in "
+                            "a single non-self-inverse (or odd-count) "
+                            "permutation class: one face direction never "
+                            "gets its return leg",
+                        )
+                    )
+                elif n != 2 and not partitioned:
+                    out.append(
+                        _finding(
+                            case,
+                            "ANL605",
+                            f"pair-count:{a}:loop{len(path)}",
+                            f"exchange group (loop depth {len(path)}) "
+                            f"carries {n} ppermutes over axis {a!r} on a "
+                            "MONOLITHIC plan; expected exactly 2",
+                        )
+                    )
+            else:
                 out.append(
                     _finding(
                         case,
                         "ANL605",
                         f"pair-count:{a}:loop{len(path)}",
                         f"exchange group (loop depth {len(path)}) carries "
-                        f"{n} ppermutes over axis {a!r}; a width-k "
-                        "exchange is exactly one low-face and one "
-                        "high-face permute per superstep call",
-                    )
-                )
-        for a, ax_sites in by_axis.items():
-            if len(ax_sites) != 2:
-                continue
-            p0 = frozenset(ax_sites[0].perm or ())
-            p1 = frozenset(ax_sites[1].perm or ())
-            if frozenset((d, s) for s, d in p0) != p1:
-                out.append(
-                    _finding(
-                        case,
-                        "ANL603",
-                        f"inverse-pair:{a}",
-                        f"the two ppermutes over axis {a!r} are not "
-                        f"inverse permutations ({sorted(p0)} vs "
-                        f"{sorted(p1)}): opposite faces must be matched "
-                        "send/recv pairs or a boundary rank deadlocks "
-                        "waiting for the return leg",
+                        f"{len(classes)} distinct permutation classes "
+                        f"over axis {a!r}; a ring exchange has exactly "
+                        "the +1 and -1 shifts (partitioned sub-blocks "
+                        "reuse them, never mint new ones)",
                     )
                 )
 
 
 def _check_halo_order(case, sites, out: List[Finding]):
-    """Face-shape consistency with the configured exchange ordering."""
+    """Face-shape consistency with the configured exchange ordering.
+
+    Partition-aware: sub-block permutes of one face direction (same
+    loop body, same axis, same permutation class) are checked as a
+    GROUP — on every non-exchange dim their extents must either all
+    equal the contracted extent (the un-partitioned dims) or sum to it
+    exactly (the partition dim tiles the face with no gap and no
+    overlap). A monolithic face is the singleton group, which reduces
+    to the original exact check."""
     if case.kind.startswith("ensemble"):
         order = "axis"  # the ensemble pins axis ordering by contract
     else:
         order = case.cfg.halo_order
     local = case.cfg.local_shape
     axis_pos = {a: i for i, a in enumerate(case.spatial_axes)}
+    groups: Dict[Tuple, List[Tuple[int, ...]]] = {}
     for s in sites:
         if s.prim != "ppermute" or not s.in_shapes:
             continue
         axis = s.axes[0] if s.axes else None
         if axis not in axis_pos:
             continue
-        i = axis_pos[axis]
         dims = _spatial_dims(case, s.in_shapes[0])
         if len(dims) != 3:
             continue
-        w = dims[i]
+        groups.setdefault(
+            (s.loop_path, axis, frozenset(s.perm or ())), []
+        ).append(dims)
+    for (_, axis, perm), dim_list in groups.items():
+        self_inverse = frozenset((d, s) for s, d in perm) == perm
+        i = axis_pos[axis]
+        w = dim_list[0][i]
+        if any(d[i] != w for d in dim_list):
+            out.append(
+                _finding(
+                    case,
+                    "ANL604",
+                    f"halo-order:{axis}",
+                    f"{order}-ordered exchange ships sub-blocks of mixed "
+                    f"ghost thickness over {axis!r}: "
+                    f"{sorted(set(d[i] for d in dim_list))} — every "
+                    "partition of one face must carry the same width",
+                )
+            )
+            continue
         for j in range(3):
             if j == i:
                 continue
             expect = (
                 local[j] + 2 * w if (order == "axis" and j < i) else local[j]
             )
-            if dims[j] != expect:
-                out.append(
-                    _finding(
-                        case,
-                        "ANL604",
-                        f"halo-order:{axis}",
-                        f"{order}-ordered exchange sends a face over "
-                        f"{axis!r} with shape {dims}; axis {j} extent "
-                        f"should be {expect} (local {local[j]}, width "
-                        f"{w}) — the face does not carry the ghost "
-                        "extension this ordering contracts, so corner "
-                        "data is dropped or double-shipped",
-                    )
+            vals = [d[j] for d in dim_list]
+            if all(v == expect for v in vals):
+                continue
+            if len(vals) > 1 and sum(vals) == expect:
+                continue  # partitioned sub-blocks tile the extent exactly
+            # a SELF-INVERSE permutation (periodic size-2 ring: shift +1
+            # == shift -1) merges BOTH face directions into one class,
+            # so the sub-blocks legally tile the extent exactly TWICE
+            # (each direction once); any other mismatch still fires
+            if (
+                self_inverse
+                and len(vals) > 1
+                and len(vals) % 2 == 0
+                and sum(vals) == 2 * expect
+            ):
+                continue
+            out.append(
+                _finding(
+                    case,
+                    "ANL604",
+                    f"halo-order:{axis}",
+                    f"{order}-ordered exchange sends face block(s) over "
+                    f"{axis!r} with shapes {sorted(dim_list)}; axis {j} "
+                    f"extents should equal (or, partitioned, sum to) "
+                    f"{expect} (local {local[j]}, width {w}) — the face "
+                    "does not carry the ghost extension this ordering "
+                    "contracts, so corner data is dropped or "
+                    "double-shipped",
                 )
-                break
+            )
+            break
 
 
 def _check_replication(case, closed, out: List[Finding]):

@@ -115,6 +115,10 @@ def _case_key(cfg, kind: str) -> str:
         f"tb{cfg.time_blocking}",
         cfg.halo_order,
     ]
+    if cfg.halo_plan != "monolithic":
+        # plan-mode key leg only when non-default, so every fingerprint
+        # minted before the knob existed stays stable
+        bits.append(cfg.halo_plan)
     if cfg.overlap:
         bits.append("overlap")
     bits.append(kind)
@@ -276,11 +280,21 @@ def judged_matrix(num_devices: Optional[int] = None) -> List[ProgramCase]:
                 "time_blocking": (1, 2, 3, 4),
                 "halo_order": ("axis", "pairwise"),
                 "overlap": (False, True),
+                # plan-built programs certify beside the classic path:
+                # partitioned sub-block permutes must still compose to
+                # the exact inverse-pair ring shifts (ANL601-607) and
+                # the full ghost footprint (ANL701)
+                "halo_plan": ("monolithic", "partitioned"),
             },
             compile_keys,
         )
         cases += _solver_cases(
-            base27, {"time_blocking": (1, 2, 3)}, compile_keys
+            base27,
+            {
+                "time_blocking": (1, 2, 3),
+                "halo_plan": ("monolithic", "partitioned"),
+            },
+            compile_keys,
         )
         cases += _solver_cases(
             base_bf16, {"time_blocking": (1, 2)}, compile_keys
@@ -293,7 +307,10 @@ def judged_matrix(num_devices: Optional[int] = None) -> List[ProgramCase]:
                 mesh=MeshConfig(shape=(4, 1, 1)),
                 backend="jnp",
             ),
-            {"time_blocking": (1, 3)},
+            {
+                "time_blocking": (1, 3),
+                "halo_plan": ("monolithic", "partitioned"),
+            },
             compile_keys,
         )
     cases += _ensemble_cases(n)

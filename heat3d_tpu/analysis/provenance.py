@@ -33,6 +33,9 @@ ROUTE_FIELDS = (
     "fused_dma_emulated",
     "streamk_path",
     "streamk_emulated",
+    # exchange-plan mode (monolithic | partitioned): the partitioned A/B
+    # changes the message schedule, not the bytes — rows must carry it
+    "halo_plan",
 )
 MAX_REPORT = 20
 
@@ -94,6 +97,13 @@ def check_row(r: dict) -> list:
     elif r.get("bench") == "halo":
         if "platform" not in r:
             problems.append("missing 'platform'")
+        # halo p50 rows are THE judged metric of the plan A/B: a row that
+        # cannot say which exchange schedule it measured is unjudgeable
+        if "halo_plan" not in r:
+            problems.append(
+                "missing 'halo_plan' (exchange-plan provenance — a "
+                "partitioned p50 must not masquerade as monolithic)"
+            )
     if r.get("bench") in ("throughput", "halo") and not isinstance(
         r.get("sync_rtt_s"), (int, float)
     ):

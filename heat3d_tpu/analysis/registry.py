@@ -110,6 +110,14 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
     "slo_verdict": {"kind": "point", "module": "obs/perf/slo.py",
                     "desc": "SLO evaluation: verdict + per-objective "
                             "burn rates"},
+    # exchange plans (parallel/plan.py)
+    "exchange_plan_built": {"kind": "point", "module": "parallel/plan.py",
+                            "desc": "persistent exchange plan constructed "
+                                    "(mode, transport, width, messages) — "
+                                    "once per plan key per run"},
+    "plan_cache_hit": {"kind": "point", "module": "parallel/plan.py",
+                       "desc": "exchange plan reused from the process "
+                               "cache (once per plan key per run)"},
     # autotuning
     "tune_search_start": {"kind": "point", "module": "tune/measure.py",
                           "desc": "search opened: space, budget, key"},
@@ -196,6 +204,17 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
                            "desc": "27pt separable-decomposition route"},
     "HEAT3D_NO_DIRECT": {"module": "parallel/step.py, ops/stencil_pallas.py",
                          "desc": "1 disables the direct kernel routes"},
+    "HEAT3D_NO_PLAN": {"module": "parallel/plan.py",
+                       "desc": "1 bypasses the exchange-plan layer (legacy "
+                               "ad-hoc dispatch; partitioned degrades to "
+                               "monolithic — the parity tests' reference "
+                               "arm)"},
+    "HEAT3D_PLAN_PART_MIN_BYTES": {
+        "module": "parallel/plan.py",
+        "desc": "partition granularity floor in bytes (default 1 MiB): "
+                "faces below it ship whole even under "
+                "halo_plan=partitioned; 0 forces genuine sub-blocks "
+                "(the IR matrix sets it)"},
     "HEAT3D_DIRECT_INTERPRET": {"module": "parallel/step.py",
                                 "desc": "1 routes kernels through the Pallas interpreter off-TPU (tests)"},
     "HEAT3D_DIRECT_FORCE": {"module": "parallel/step.py",

@@ -154,6 +154,14 @@ def bench_throughput(
         "overlap": cfg.overlap,
         "halo": cfg.halo,
         "halo_order": cfg.halo_order,
+        # exchange-plan provenance (knob-drift + ROUTE_FIELDS contract):
+        # a partitioned row's traffic is byte-identical to monolithic but
+        # its message schedule is not — the A/B must be keyable from the
+        # row alone. The EFFECTIVE mode is recorded (HEAT3D_NO_PLAN
+        # degrades partitioned to the ad-hoc monolithic schedule; the
+        # row must say what ran — docs/TUNING.md "Persistent exchange
+        # plans")
+        "halo_plan": _effective_halo_plan(cfg),
         "steps": steps,
         "steps_requested": steps_requested,
         # ensemble-workload provenance (REQUIRED by check_provenance.py on
@@ -213,6 +221,17 @@ def bench_throughput(
         "bench_step_latency_seconds", "bench throughput per-step latency"
     ).observe(best / steps)
     return row
+
+
+def _effective_halo_plan(cfg: SolverConfig) -> str:
+    """The ONE effective-mode rule (parallel.plan.effective_halo_plan):
+    what the rows record is what executed, incl. the HEAT3D_NO_PLAN
+    degradation. No fail-soft wrapper: the function is pure env+config
+    inspection, and if parallel.plan itself cannot import, the solver
+    that produced the measurement could not have run either."""
+    from heat3d_tpu.parallel.plan import effective_halo_plan
+
+    return effective_halo_plan(cfg)
 
 
 def _resolved_streamk(cfg: SolverConfig, direct: bool = None) -> bool:
@@ -448,6 +467,29 @@ def bench_halo(
         halo_cost["cost_analysis_error"] = (
             f"{type(e).__name__}: {str(e)[:120]}"
         )
+    # planned-exchange provenance + the plan's own transport model
+    # (messages and boundary bytes per device per exchange) beside XLA's
+    # cost bytes — the roofline's planned-exchange arm reads these. The
+    # model prices the EFFECTIVE schedule (see _effective_halo_plan).
+    # Fail-soft like every other telemetry field on the row.
+    eff_hp = _effective_halo_plan(cfg)
+    plan_fields = {}
+    try:
+        from heat3d_tpu.parallel.plan import plan_for
+
+        t = plan_for(
+            dataclasses.replace(cfg, halo_plan=eff_hp)
+        ).traffic(
+            cfg.local_shape, jnp.dtype(cfg.precision.storage).itemsize
+        )
+        plan_fields = {
+            "plan_messages_per_exchange": t["messages"],
+            "plan_bytes_per_device": t["bytes_per_device"],
+        }
+    except Exception as e:  # noqa: BLE001 - telemetry fails soft
+        plan_fields = {
+            "plan_model_error": f"{type(e).__name__}: {str(e)[:120]}"
+        }
     row = {
         "bench": "halo",
         "ts": _utc_now(),
@@ -456,6 +498,8 @@ def bench_halo(
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "halo_order": cfg.halo_order,
+        "halo_plan": eff_hp,
+        **plan_fields,
         "iters": iters,
         "exchanges_per_program": k,
         "p50_us": percentile(times, 50) * 1e6,
@@ -561,7 +605,7 @@ def run_suite(
         )
         halo_key = (
             cfg.grid.shape, cfg.mesh.shape, cfg.precision.storage,
-            cfg.halo, cfg.halo_order,
+            cfg.halo, cfg.halo_order, _effective_halo_plan(cfg),
         )
         if halo_key not in halo_seen:
             halo_seen.add(halo_key)

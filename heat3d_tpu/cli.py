@@ -98,6 +98,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "corner-propagating — required by 27pt) or 'pairwise' "
                    "(all six face permutes concurrent, stagger-tolerant; "
                    "7pt only — the tuner A/Bs the two)")
+    p.add_argument("--halo-plan", choices=["monolithic", "partitioned", "auto"],
+                   default="monolithic",
+                   help="exchange-plan mode (parallel/plan.py): "
+                   "'monolithic' (one collective per face), 'partitioned' "
+                   "(each face ships as early-bird sub-blocks — more, "
+                   "smaller messages overlapped with compute; "
+                   "value-identical, pins the exchange path), or 'auto' "
+                   "(resolve through the tuning cache; docs/TUNING.md)")
     p.add_argument("--time-blocking", type=int, default=1,
                    help="stencil updates per ghost exchange in the "
                    "fixed-step loop (k>1 = temporal blocking: width-k "
@@ -201,6 +209,7 @@ def config_from_args(args) -> SolverConfig:
         halo=args.halo,
         time_blocking=args.time_blocking,
         halo_order=args.halo_order,
+        halo_plan=args.halo_plan,
     )
 
 
@@ -281,6 +290,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
         backend=cfg.backend,
         halo=cfg.halo,
         halo_order=cfg.halo_order,
+        halo_plan=cfg.halo_plan,
         overlap=cfg.overlap,
         time_blocking=cfg.time_blocking,
         steps=cfg.run.num_steps,

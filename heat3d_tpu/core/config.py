@@ -232,6 +232,18 @@ class SolverConfig:
     # never read them (7pt) at time_blocking <= 1 on the ppermute
     # transport; the tuner A/Bs the two orderings.
     halo_order: str = "axis"
+    # Exchange-plan mode (heat3d_tpu.parallel.plan; docs/TUNING.md):
+    # 'monolithic' (one collective per face — the classic structure,
+    # permutations and slices precomputed once per run by the persistent
+    # ExchangePlan), 'partitioned' (each face ships as sub-blocks, every
+    # sub-block its own ppermute issued from its own boundary strip —
+    # the early-bird ordering of the persistent/partitioned-MPI stencil
+    # literature; assembled ghosts are bitwise-identical to monolithic,
+    # so it is valid on every stencil/ordering/decomposition, but it
+    # pins the exchange path — the in-kernel ghost-synthesis routes
+    # stand down — and requires the ppermute transport), or 'auto'
+    # (resolve through the tuning cache, static fallback monolithic).
+    halo_plan: str = "monolithic"
 
     def __post_init__(self):
         if self.halo not in ("ppermute", "dma", "auto"):
@@ -244,6 +256,18 @@ class SolverConfig:
         if self.halo_order not in ("axis", "pairwise"):
             raise ValueError(
                 f"unknown halo_order {self.halo_order!r} (want axis|pairwise)"
+            )
+        if self.halo_plan not in ("monolithic", "partitioned", "auto"):
+            raise ValueError(
+                f"unknown halo_plan {self.halo_plan!r} "
+                "(want monolithic|partitioned|auto)"
+            )
+        if self.halo_plan == "partitioned" and self.halo == "dma":
+            raise ValueError(
+                "halo_plan='partitioned' applies to the ppermute "
+                "transport; the DMA slab exchange kernels ship whole "
+                "faces by construction — use halo='ppermute' (or plan "
+                "mode 'monolithic')"
             )
         if self.halo_order == "pairwise":
             # pairwise ordering leaves corner/edge ghosts at bc_value:

@@ -104,6 +104,7 @@ def row_key(row: Dict[str, Any]) -> Optional[Tuple]:
             bool(row.get("overlap")),
             row.get("halo", "ppermute"),
             row.get("halo_order", "axis"),
+            row.get("halo_plan", "monolithic"),
             row.get("backend", "auto"),
             # ensemble workload axis: a packed batch's aggregate rate must
             # only ever baseline against the same batch shape — without
@@ -120,6 +121,7 @@ def row_key(row: Dict[str, Any]) -> Optional[Tuple]:
             row.get("dtype"),
             row.get("halo", "ppermute"),
             row.get("halo_order", "axis"),
+            row.get("halo_plan", "monolithic"),
             _platform_class(row),
         )
     if bench == "driver":

@@ -689,6 +689,12 @@ def bytes_per_cell_update(row) -> tuple:
         # update (tb updates share one exchange)
         per_update = 2 * item + 2 * item / tb
         path = f"exchange(tb={tb})"
+    # planned-exchange arm: a partitioned plan ships the SAME boundary
+    # bytes as monolithic in sub-block messages (the p50 A/B measures
+    # schedule, not traffic) — label the path so partitioned rows are
+    # attributable without changing the byte model
+    if row.get("halo_plan") == "partitioned":
+        path += "+planned-partitioned"
     return per_update, path
 
 

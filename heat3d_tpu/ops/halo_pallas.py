@@ -338,3 +338,39 @@ def exchange_halo_dma(
             interpret=interpret,
         )
     return u
+
+
+def exchange_halo_dma_planned(
+    u: jax.Array,
+    plan,
+    bc_value: float = 0.0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Plan-driven DMA exchange: consume an
+    :class:`~heat3d_tpu.parallel.plan.ExchangePlan`'s precomputed axis
+    schedule (corner-propagation order, axis names/sizes, width) instead
+    of re-deriving them from the mesh config on every trace — the step
+    builders hand every transport the same plan object. DMA plans are
+    monolithic by construction (``plan.build_plan`` rejects partitioned
+    DMA: the slab kernels stage and ship whole faces; sub-block RDMA is
+    the in-kernel-overlap arc's territory, ROADMAP). Must run inside
+    shard_map over the plan's mesh."""
+    if plan.transport != "dma" or plan.mode != "monolithic":
+        raise ValueError(
+            f"exchange_halo_dma_planned wants a monolithic DMA plan, got "
+            f"transport={plan.transport!r} mode={plan.mode!r}"
+        )
+    mesh_axes = plan.mesh.axis_names
+    for spec in plan.axis_specs:
+        u = exchange_axis_dma(
+            u,
+            spec.axis,
+            spec.name,
+            spec.size,
+            mesh_axes,
+            plan.periodic,
+            bc_value,
+            width=plan.width,
+            interpret=interpret,
+        )
+    return u

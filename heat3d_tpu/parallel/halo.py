@@ -54,12 +54,19 @@ def exchange_axis(
     periodic: bool,
     bc_value: float = 0.0,
     width: int = 1,
+    ghosts_fn=None,
 ) -> jax.Array:
     """Pad local block ``u`` with ``width`` ghost layers along ``axis``,
     filled from the neighbors along mesh axis ``axis_name`` (or the BC at
     the domain boundary). Must run inside shard_map. Returns u grown by
     2*width on ``axis``. width > 1 serves temporal blocking (several stencil
-    applications per exchange — fewer, larger messages)."""
+    applications per exchange — fewer, larger messages).
+
+    ``ghosts_fn`` overrides the communication core (an
+    :class:`~heat3d_tpu.parallel.plan.ExchangePlan` supplies its
+    precomputed-permutation or partitioned-sub-block form); signature
+    ``(lo_face, hi_face, axis, axis_name, axis_size, periodic, bc_value)``,
+    default :func:`axis_ghosts` (which ignores ``axis``)."""
     n = u.shape[axis]
     if n < width:
         raise ValueError(
@@ -70,9 +77,15 @@ def exchange_axis(
     with named_phase(f"halo.{axis_name}"):
         lo_face = lax.slice_in_dim(u, 0, width, axis=axis)
         hi_face = lax.slice_in_dim(u, n - width, n, axis=axis)
-        ghost_lo, ghost_hi = axis_ghosts(
-            lo_face, hi_face, axis_name, axis_size, periodic, bc_value
-        )
+        if ghosts_fn is None:
+            ghost_lo, ghost_hi = axis_ghosts(
+                lo_face, hi_face, axis_name, axis_size, periodic, bc_value
+            )
+        else:
+            ghost_lo, ghost_hi = ghosts_fn(
+                lo_face, hi_face, axis, axis_name, axis_size, periodic,
+                bc_value,
+            )
         return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
 
 
@@ -83,10 +96,15 @@ def axis_ghosts(
     axis_size: int,
     periodic: bool,
     bc_value: float = 0.0,
+    perms=None,
 ):
     """The communication core of one axis exchange: given my two boundary
     faces, return my two ghost faces (neighbor data, wrap, or the BC).
-    Must run inside shard_map."""
+    Must run inside shard_map. ``perms`` takes precomputed
+    ``(shift_perm(+1), shift_perm(-1))`` pairs (an
+    :class:`~heat3d_tpu.parallel.plan.ExchangePlan` builds them once per
+    run instead of once per trace); ``None`` derives them in place —
+    identical values either way."""
     if axis_size == 1 and periodic:
         # self-wrap: my own faces are my ghosts
         return hi_face, lo_face
@@ -95,19 +113,37 @@ def axis_ghosts(
             jnp.full_like(lo_face, bc_value),
             jnp.full_like(hi_face, bc_value),
         )
+    if perms is None:
+        perm_up = shift_perm(axis_size, +1, periodic)
+        perm_down = shift_perm(axis_size, -1, periodic)
+    else:
+        perm_up, perm_down = perms
     # my low ghost = low neighbor's high face: shift high faces "up" (+1)
-    ghost_lo = lax.ppermute(
-        hi_face, axis_name, shift_perm(axis_size, +1, periodic)
-    )
+    ghost_lo = lax.ppermute(hi_face, axis_name, perm_up)
     # my high ghost = high neighbor's low face: shift low faces "down" (-1)
-    ghost_hi = lax.ppermute(
-        lo_face, axis_name, shift_perm(axis_size, -1, periodic)
+    ghost_hi = lax.ppermute(lo_face, axis_name, perm_down)
+    return substitute_domain_bc(
+        ghost_lo, ghost_hi, axis_name, axis_size, periodic, bc_value
     )
-    # bc_value may be a TRACED scalar (the batched ensemble path threads a
-    # per-member boundary value through vmap — serve/ensemble.py); the
-    # 0.0 fast path then cannot be decided at trace time, and substituting
-    # unconditionally is value-identical (undelivered ppermute outputs are
-    # zero-filled, so where(edge, 0.0, ghost) == ghost).
+
+
+def substitute_domain_bc(
+    ghost_lo: jax.Array,
+    ghost_hi: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    periodic: bool,
+    bc_value=0.0,
+):
+    """Domain-edge BC substitution over freshly exchanged ghost faces —
+    the ONE tail every ppermute-built ghost pair (monolithic or
+    partitioned sub-block assembly, parallel/plan.py) runs, so the edge
+    semantics cannot diverge between plan modes. bc_value may be a
+    TRACED scalar (the batched ensemble path threads a per-member
+    boundary value through vmap — serve/ensemble.py); the 0.0 fast path
+    then cannot be decided at trace time, and substituting
+    unconditionally is value-identical (undelivered ppermute outputs are
+    zero-filled, so where(edge, 0.0, ghost) == ghost)."""
     if not periodic and (isinstance(bc_value, jax.Array) or bc_value != 0.0):
         idx = lax.axis_index(axis_name)
         ghost_lo = jnp.where(idx == 0, jnp.full_like(ghost_lo, bc_value), ghost_lo)
@@ -123,17 +159,20 @@ def exchange_halo(
     bc: BoundaryCondition,
     bc_value: float = 0.0,
     width: int = 1,
+    ghosts_fn=None,
 ) -> jax.Array:
     """Full 3D ghost exchange: local (nx,ny,nz) -> (nx+2w,ny+2w,nz+2w),
     ghosts filled from mesh neighbors / the boundary condition. Axis-ordered
     so the result equals a global pad-then-shard (corner ghosts included).
-    Must run inside shard_map over the mesh in ``mesh_cfg``."""
+    Must run inside shard_map over the mesh in ``mesh_cfg``. ``ghosts_fn``
+    swaps the per-axis communication core (see :func:`exchange_axis`)."""
     periodic = bc is BoundaryCondition.PERIODIC
     for axis, (axis_name, axis_size) in enumerate(
         zip(mesh_cfg.axis_names, mesh_cfg.shape)
     ):
         u = exchange_axis(
-            u, axis, axis_name, axis_size, periodic, bc_value, width
+            u, axis, axis_name, axis_size, periodic, bc_value, width,
+            ghosts_fn=ghosts_fn,
         )
     return u
 
@@ -144,6 +183,7 @@ def exchange_halo_pairwise(
     bc: BoundaryCondition,
     bc_value: float = 0.0,
     width: int = 1,
+    ghosts_fn=None,
 ) -> jax.Array:
     """Neighbor-pairwise ghost exchange: all six face ppermutes issued
     concurrently from the RAW boundary faces, with no cross-axis data
@@ -175,9 +215,14 @@ def exchange_halo_pairwise(
             # every axis_ghosts call reads only the RAW faces of u: the
             # six permutes have no data dependence on each other, so
             # XLA is free to run them all concurrently
-            ghosts.append(
-                axis_ghosts(lo, hi, name, size, periodic, bc_value)
-            )
+            if ghosts_fn is None:
+                ghosts.append(
+                    axis_ghosts(lo, hi, name, size, periodic, bc_value)
+                )
+            else:
+                ghosts.append(
+                    ghosts_fn(lo, hi, axis, name, size, periodic, bc_value)
+                )
         out = u
         for axis, (glo, ghi) in enumerate(ghosts):
             # earlier axes already grew `out` by 2*width; the raw-face

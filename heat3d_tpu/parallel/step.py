@@ -28,7 +28,6 @@ from heat3d_tpu.core.config import (
 from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
 from heat3d_tpu.obs.trace import named_phase, scoped
 from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
-from heat3d_tpu.parallel.halo import exchange_halo
 from heat3d_tpu.utils.compat import shard_map
 
 # Local compute on a ghost-padded block: (up, taps, compute_dtype, out_dtype) -> interior
@@ -87,32 +86,21 @@ def _pin_padding(
 def exchange(
     u_local: jax.Array, cfg: SolverConfig, width: int = 1
 ) -> jax.Array:
-    """Ghost exchange via the configured transport (cfg.halo). The
-    ``heat3d.halo_exchange`` named scope brackets both transports so a
+    """Ghost exchange via this config's persistent :class:`ExchangePlan`
+    (heat3d_tpu.parallel.plan): transport (cfg.halo), ordering
+    (cfg.halo_order) and plan mode (cfg.halo_plan — monolithic face
+    collectives or partitioned early-bird sub-block sends) are all
+    resolved ONCE per (mesh, bc, width, knobs) and reused by every step,
+    superstep, phase and bench program in the process. ``HEAT3D_NO_PLAN``
+    falls back to the legacy ad-hoc dispatch (bitwise-identical on the
+    monolithic path — the parity tests' reference arm). The
+    ``heat3d.halo_exchange`` named scope brackets every transport so a
     profiler trace attributes the permutes/DMAs to OUR phase, not to raw
     XLA op names (scripts/summarize_trace.py groups on it)."""
+    from heat3d_tpu.parallel.plan import exchange_with_plan
+
     with named_phase("halo_exchange"):
-        if cfg.halo == "dma":
-            from heat3d_tpu.ops.halo_pallas import exchange_halo_dma
-
-            return exchange_halo_dma(
-                u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value,
-                width=width,
-            )
-        if cfg.halo_order == "pairwise":
-            # skew-tolerant ordering: six concurrent face ppermutes, no
-            # axis chain (config validation restricts it to face-only
-            # stencils at tb<=1, where every ghost the stencil reads is
-            # value-identical to the axis-ordered exchange)
-            from heat3d_tpu.parallel.halo import exchange_halo_pairwise
-
-            return exchange_halo_pairwise(
-                u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value,
-                width,
-            )
-        return exchange_halo(
-            u_local, cfg.mesh, cfg.stencil.bc, cfg.stencil.bc_value, width
-        )
+        return exchange_with_plan(u_local, cfg, width)
 
 
 def _pin_outside_domain(
@@ -217,6 +205,12 @@ def _kernel_env_gate(cfg: SolverConfig):
         # the direct/fused kernel families synthesize or patch ghosts
         # assuming axis-ordered corner propagation; the pairwise ordering
         # A/B is an EXCHANGE-path knob, so it pins the exchange path
+        return False, False
+    if cfg.halo_plan == "partitioned":
+        # partitioned early-bird sends are likewise an exchange-path
+        # structure (the kernels never issue per-face collectives to
+        # partition) — the A/B must measure the exchange path, not
+        # silently run a kernel that ignores the knob
         return False, False
     interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
     forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))

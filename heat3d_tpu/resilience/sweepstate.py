@@ -94,6 +94,9 @@ ROUTE_ENV_KNOBS = (
     "HEAT3D_NO_DIRECT",
     "HEAT3D_DIRECT_INTERPRET",
     "HEAT3D_DIRECT_FORCE",
+    # bypasses the exchange-plan layer (partitioned degrades to the
+    # ad-hoc monolithic path — a different measured schedule)
+    "HEAT3D_NO_PLAN",
 )
 
 
@@ -111,15 +114,26 @@ def row_key(cfg, bench: str = "throughput") -> str:
     # of the identity — suffixed ONLY when non-default, so every journal
     # written before the knob existed keeps resuming cleanly
     ho = "" if cfg.halo_order == "axis" else f":ho{cfg.halo_order}"
+    # the exchange-plan mode changes the message schedule a row measures
+    # — suffixed ONLY when non-default, same legacy-journal rule as ho.
+    # The EFFECTIVE mode keys the journal (HEAT3D_NO_PLAN degrades
+    # partitioned to the ad-hoc monolithic schedule; the key must match
+    # what the row measured — one rule, parallel.plan).
+    from heat3d_tpu.parallel.plan import effective_halo_plan
+
+    hp_mode = effective_halo_plan(cfg)
+    hp = "" if hp_mode == "monolithic" else f":hp{hp_mode}"
     if bench == "halo":
-        return f"halo:g{g}:m{m}:{cfg.precision.storage}:h{cfg.halo}{ho}"
+        return (
+            f"halo:g{g}:m{m}:{cfg.precision.storage}:h{cfg.halo}{ho}{hp}"
+        )
     env_bits = ",".join(
         f"{k}={os.environ[k]}" for k in ROUTE_ENV_KNOBS if k in os.environ
     )
     return (
         f"{bench}:g{g}:m{m}:{cfg.stencil.kind}:{cfg.precision.storage}"
         f":c{cfg.precision.compute}:b{cfg.backend}:tb{cfg.time_blocking}"
-        f":ov{int(cfg.overlap)}:h{cfg.halo}{ho}"
+        f":ov{int(cfg.overlap)}:h{cfg.halo}{ho}{hp}"
         + (f":env[{env_bits}]" if env_bits else "")
     )
 

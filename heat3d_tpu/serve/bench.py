@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from heat3d_tpu import obs
+from heat3d_tpu.parallel.plan import effective_halo_plan
 from heat3d_tpu.serve.ensemble import EnsembleSolver
 from heat3d_tpu.serve.scenario import ScenarioBatch
 from heat3d_tpu.utils.timing import (
@@ -87,6 +88,10 @@ def bench_ensemble_throughput(
         "overlap": cfg.overlap,
         "halo": cfg.halo,
         "halo_order": cfg.halo_order,
+        # the EFFECTIVE plan mode (HEAT3D_NO_PLAN degrades partitioned
+        # to the ad-hoc monolithic schedule — the solo harness's rule,
+        # one source: parallel.plan.effective_halo_plan)
+        "halo_plan": effective_halo_plan(cfg),
         "steps": steps,
         "steps_requested": steps_requested,
         "seconds_best": best,

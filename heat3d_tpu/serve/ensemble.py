@@ -75,7 +75,7 @@ from heat3d_tpu.ops.stencil_jnp import (
     emission_positions,
     residual_sumsq,
 )
-from heat3d_tpu.parallel.halo import exchange_halo
+from heat3d_tpu.parallel.plan import exchange_with_plan
 from heat3d_tpu.parallel.step import (
     _fill_mid_ghosts,
     _pin_padding,
@@ -288,10 +288,13 @@ class EnsembleSolver:
     def _member_step(self, ul, w, coef, bcv):
         """One member's single update — the parametric mirror of
         ``parallel.step._local_step`` (same exchange, same chain emission,
-        same padding pin; coefficients traced)."""
+        same padding pin; coefficients traced). The exchange rides the
+        shared persistent plan (parallel.plan) with the member's TRACED
+        boundary value as the apply-time argument, so one plan serves
+        every member and every bucket of this mesh shape."""
         cfg = self.cfg
         with named_phase("halo_exchange"):
-            up = exchange_halo(ul, cfg.mesh, cfg.stencil.bc, bcv)
+            up = exchange_with_plan(ul, cfg, 1, bcv)
         with named_phase("stencil"):
             out = self._member_apply(up, w, coef)
             return _pin_padding(out, cfg, bc_value=bcv)
@@ -302,7 +305,7 @@ class EnsembleSolver:
         ghost-ring recompute, storage-dtype round trips)."""
         cfg, k = self.cfg, self.k
         with named_phase("halo_exchange"):
-            cur = exchange_halo(ul, cfg.mesh, cfg.stencil.bc, bcv, width=k)
+            cur = exchange_with_plan(ul, cfg, k, bcv)
         with named_phase("stencil"):
             for j in range(k):
                 cur = self._member_apply(cur, w, coef)

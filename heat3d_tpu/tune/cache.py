@@ -35,7 +35,8 @@ Entry schema (``lint`` checks it; ``schema`` guards forward drift)::
 provenance discipline for everything the tuner measures.
 
 Resolution (:func:`resolve_config`) replaces ONLY the auto knobs —
-``backend='auto'``, ``halo='auto'``, ``time_blocking=0`` — with the
+``backend='auto'``, ``halo='auto'``, ``time_blocking=0``,
+``halo_plan='auto'`` — with the
 cached winner's values; explicit knobs are never overridden, and the
 mesh is never swapped (an explicitly chosen decomposition is the user's
 call; ``tune apply`` emits it as a flag instead). Every resolution lands
@@ -43,7 +44,8 @@ in the run ledger as ``tune_cache_hit`` / ``tune_cache_miss`` /
 ``tune_cache_stale`` (stale = jax-version mismatch, schema drift, or a
 cached knob invalid in the current env, e.g. ``halo='dma'`` off-TPU);
 misses and staleness fall back to the static defaults (halo
-``ppermute``, time_blocking 1, backend left ``auto``). Resolution fails
+``ppermute``, time_blocking 1, halo_plan ``monolithic``, backend left
+``auto``). Resolution fails
 soft: no cache error can kill the run being configured.
 
 ``HEAT3D_TUNE_CACHE`` overrides the store path (default
@@ -69,7 +71,9 @@ ENV_DISABLE = "HEAT3D_TUNE_DISABLE"
 SCHEMA_VERSION = 1
 
 # the knobs an entry's config must carry (lint + resolution contract)
-CONFIG_KNOBS = ("backend", "halo", "overlap", "time_blocking", "halo_order")
+CONFIG_KNOBS = (
+    "backend", "halo", "overlap", "time_blocking", "halo_order", "halo_plan",
+)
 
 # in-process memo: (path) -> (mtime_ns, doc). One stat per lookup instead
 # of one parse per solver construction (backend='auto' is the default
@@ -147,6 +151,7 @@ def config_knobs(cfg: SolverConfig) -> Dict[str, Any]:
         "overlap": bool(cfg.overlap),
         "time_blocking": int(cfg.time_blocking),
         "halo_order": cfg.halo_order,
+        "halo_plan": cfg.halo_plan,
         "mesh": list(cfg.mesh.shape),
     }
 
@@ -326,7 +331,7 @@ def lint(path: Optional[str] = None) -> List[str]:
             tb = cfgd.get("time_blocking")
             if tb is not None and (not isinstance(tb, int) or tb < 1):
                 bad.append(f"{where}: time_blocking {tb!r} not an int >= 1")
-            for knob in ("backend", "halo"):
+            for knob in ("backend", "halo", "halo_plan"):
                 if cfgd.get(knob) == "auto":
                     bad.append(
                         f"{where}: {knob}='auto' is not a concrete route "
@@ -363,6 +368,8 @@ def _static_fallback(cfg: SolverConfig) -> SolverConfig:
         kw["halo"] = "ppermute"
     if cfg.time_blocking == 0:
         kw["time_blocking"] = 1
+    if cfg.halo_plan == "auto":
+        kw["halo_plan"] = "monolithic"
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
@@ -374,6 +381,8 @@ def _auto_knobs(cfg: SolverConfig) -> List[str]:
         autos.append("halo")
     if cfg.time_blocking == 0:
         autos.append("time_blocking")
+    if cfg.halo_plan == "auto":
+        autos.append("halo_plan")
     return autos
 
 
@@ -491,6 +500,7 @@ def _resolve(
         kw.get("halo") == "auto"
         or kw.get("backend") == "auto"
         or kw.get("time_blocking") == 0
+        or kw.get("halo_plan") == "auto"
     ):
         return _stale("entry carries unresolved auto knobs")
     try:

@@ -73,6 +73,7 @@ def _base_config(args):
         overlap=False,
         time_blocking=1,
         halo_order="axis",
+        halo_plan="monolithic",
     )
 
 
@@ -216,6 +217,11 @@ def _entry_lines(key: str, e: dict) -> str:
         # cost_redundant_flops_frac quantifies it per shape)
         speed += f"; tb={tb} winner ({tb}x fewer exchanges, ring recompute"
         speed += " priced in)"
+    if cfg.get("halo_plan") == "partitioned":
+        # partitioned-exchange winners: early-bird sub-block sends beat
+        # whole-face collectives here — more, smaller messages, transport
+        # overlapped with the remaining compute (docs/TUNING.md)
+        speed += "; partitioned-exchange winner (early-bird sub-block sends)"
     return (
         f"{key}\n"
         f"    config: {_fmt_knobs(cfg)}\n"
@@ -275,6 +281,8 @@ def cmd_apply(args) -> int:
         parts += ["--time-blocking", str(cfg["time_blocking"])]
     if cfg.get("halo_order") and cfg["halo_order"] != "axis":
         parts += ["--halo-order", str(cfg["halo_order"])]
+    if cfg.get("halo_plan") and cfg["halo_plan"] != "monolithic":
+        parts += ["--halo-plan", str(cfg["halo_plan"])]
     if cfg.get("overlap"):
         parts.append("--overlap")
     if cfg.get("mesh"):

@@ -45,6 +45,12 @@ DEFAULT_KNOBS: Dict[str, Tuple[Any, ...]] = {
     # row it lands carries cost_redundant_flops_frac for the report.
     "time_blocking": (1, 2, 3, 4),
     "halo_order": ("axis", "pairwise"),
+    # persistent-exchange-plan mode (parallel/plan.py): partitioned =
+    # early-bird sub-block sends (more, smaller messages; pins the
+    # exchange path). Value-identical to monolithic by construction, so
+    # the A/B is purely a transport-schedule measurement; dma+partitioned
+    # combos are config-rejected and pruned.
+    "halo_plan": ("monolithic", "partitioned"),
 }
 
 # knob-value parsers for CLI `--knob name=v1,v2` strings
@@ -81,11 +87,11 @@ def parse_knob_values(name: str, spec: str) -> Tuple[Any, ...]:
                 raise ValueError(f"mesh value {tok!r} (want PxQxR)")
             vals.append(dims)
         else:
-            if name == "halo" and tok == "auto":
+            if name in ("halo", "halo_plan") and tok == "auto":
                 raise ValueError(
-                    "searched halo values must be concrete "
-                    "(ppermute|dma): 'auto' means 'resolve through the "
-                    "cache this search is about to write'"
+                    f"searched {name} values must be concrete: 'auto' "
+                    "means 'resolve through the cache this search is "
+                    "about to write'"
                 )
             vals.append(tok)
     if not vals:
@@ -102,7 +108,7 @@ def check_concrete(space: Dict[str, Sequence[Any]]) -> None:
     for name, values in space.items():
         for v in values:
             if (name == "time_blocking" and isinstance(v, int) and v < 1) or (
-                name == "halo" and v == "auto"
+                name in ("halo", "halo_plan") and v == "auto"
             ):
                 raise ValueError(
                     f"search space knob {name}={v!r} is not concrete — "

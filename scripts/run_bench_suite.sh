@@ -297,6 +297,30 @@ if [[ -z "${SKIP_TUNE_SMOKE:-}" ]]; then
     || note "suite: tune cache-schema lint failed (rc=$?) — informational"
 fi
 
+# Exchange-plan A/B smoke (informational, beside the tune smoke): one
+# tiny monolithic vs partitioned throughput pair through the persistent
+# exchange plans (parallel/plan.py), judged by the tune/decide pairwise
+# logic (scripts/ab_decide.py = thin wrapper) — keeps the plan knob's
+# measure-decide loop alive end to end between chip sessions. On CPU the
+# verdict is smoke, not record (docs/TUNING.md "Persistent exchange
+# plans"; the pod A/B is POD_RUNBOOK stage 3-plan). Fails SOFT;
+# SKIP_PLAN_SMOKE=1 skips.
+if [[ -z "${SKIP_PLAN_SMOKE:-}" ]]; then
+  PLAN_LOG="${OUT%.jsonl}.plan_ab.log"
+  : > "$PLAN_LOG"
+  for hp in monolithic partitioned; do
+    if wait_tpu "plan smoke $hp"; then
+      timeout -k 30 "${ROW_TIMEOUT:-900}" \
+        python -m heat3d_tpu.bench --grid "${PLAN_GRID:-24}" \
+        --steps "${PLAN_STEPS:-8}" --bench throughput --halo-plan "$hp" \
+        2>>"$SUITE_LOG" | sed "s/^/halo_plan=$hp: /" >> "$PLAN_LOG" \
+        || note "suite: plan smoke $hp failed (rc=$?) — informational"
+    fi
+  done
+  python scripts/ab_decide.py "$PLAN_LOG" >> "$SUITE_LOG" 2>&1 \
+    || note "suite: plan A/B decide failed (rc=$?) — informational"
+fi
+
 # Serve smoke (informational, beside the tune smoke): the built-in tiny
 # multi-bucket batch through the batched scenario engine — submit ->
 # shape-bucketed packing -> streamed results, CPU-safe and sub-minute —

@@ -1018,7 +1018,172 @@ def check_deep_tb_streamk_interpret():
     print("deep_tb_streamk_interpret OK")
 
 
+def _run_solver(cfg, u_host, steps):
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    s = HeatSolver3D(cfg)
+    return s.gather(s.run(s.init_state(u_host), steps))
+
+
+def check_plan_bitwise_parity():
+    """Plan-built step/superstep programs are BITWISE-identical to the
+    ad-hoc exchange path (HEAT3D_NO_PLAN=1 — the pre-plan dispatch kept
+    verbatim) on real multi-device meshes, across stencils, temporal
+    blocking depths and both halo orderings — the tentpole acceptance
+    criterion of the persistent-exchange-plan refactor."""
+    import dataclasses
+    import os
+
+    from heat3d_tpu.parallel import plan as hplan
+
+    grid = (16, 16, 16)
+    u_host = golden.random_init(grid, seed=31)
+    combos = [
+        ("7pt", 1, "axis", (4, 1, 1)),
+        ("7pt", 1, "pairwise", (2, 2, 1)),
+        ("7pt", 2, "axis", (2, 2, 1)),
+        ("7pt", 3, "axis", (4, 1, 1)),
+        ("7pt", 4, "axis", (2, 2, 1)),
+        ("27pt", 1, "axis", (2, 2, 1)),
+        ("27pt", 2, "axis", (4, 1, 1)),
+    ]
+    for kind, tb, ho, mesh_shape in combos:
+        cfg = SolverConfig(
+            grid=GridConfig(shape=grid),
+            stencil=StencilConfig(kind=kind, bc_value=0.5),
+            mesh=MeshConfig(shape=mesh_shape),
+            backend="jnp",
+            time_blocking=tb,
+            halo_order=ho,
+        )
+        steps = max(3, tb + 1)
+        hplan.clear_plan_cache()
+        got = _run_solver(cfg, u_host, steps)
+        os.environ["HEAT3D_NO_PLAN"] = "1"
+        try:
+            want = _run_solver(cfg, u_host, steps)
+        finally:
+            del os.environ["HEAT3D_NO_PLAN"]
+        assert np.array_equal(got, want), (
+            f"plan-built program != ad-hoc exchange path bitwise "
+            f"({kind} tb={tb} {ho} mesh={mesh_shape})"
+        )
+    print("plan_bitwise_parity OK")
+
+
+def check_plan_partitioned_identity():
+    """halo_plan='partitioned' (early-bird sub-block sends) is VALUE-
+    (indeed bitwise-) identical to 'monolithic' on every judged shape,
+    including the uneven decomposition whose padded shards exercise the
+    bc-pin masks, pairwise ordering, deep temporal blocking, and
+    periodic wrap rings. The partition granularity floor is zeroed so
+    the 16^3 faces genuinely split into sub-block permutes (the default
+    1 MiB floor would ship them whole)."""
+    import dataclasses
+    import os
+
+    from heat3d_tpu.parallel import plan as hplan
+
+    os.environ[hplan.ENV_PART_MIN_BYTES] = "0"
+    hplan.clear_plan_cache()
+    combos = [
+        ((16, 16, 16), "7pt", 1, "axis", (4, 1, 1), "dirichlet", 0.5),
+        ((18, 18, 18), "7pt", 1, "axis", (4, 1, 1), "dirichlet", 0.25),
+        ((16, 16, 16), "27pt", 1, "axis", (2, 2, 1), "dirichlet", 0.0),
+        ((16, 16, 16), "7pt", 3, "axis", (2, 2, 1), "dirichlet", 0.5),
+        ((16, 16, 16), "7pt", 1, "pairwise", (4, 1, 1), "dirichlet", 0.0),
+        ((16, 16, 16), "7pt", 2, "axis", (4, 1, 1), "periodic", 0.0),
+    ]
+    for grid, kind, tb, ho, mesh_shape, bc, bcv in combos:
+        base = SolverConfig(
+            grid=GridConfig(shape=grid),
+            stencil=StencilConfig(
+                kind=kind, bc=BoundaryCondition(bc), bc_value=bcv
+            ),
+            mesh=MeshConfig(shape=mesh_shape),
+            backend="jnp",
+            time_blocking=tb,
+            halo_order=ho,
+        )
+        u_host = golden.random_init(grid, seed=37)
+        steps = max(3, tb + 1)
+        mono = _run_solver(
+            dataclasses.replace(base, halo_plan="monolithic"), u_host, steps
+        )
+        part = _run_solver(
+            dataclasses.replace(base, halo_plan="partitioned"), u_host, steps
+        )
+        assert np.array_equal(mono, part), (
+            f"partitioned != monolithic ({grid} {kind} tb={tb} {ho} "
+            f"mesh={mesh_shape} bc={bc})"
+        )
+    del os.environ[hplan.ENV_PART_MIN_BYTES]
+    print("plan_partitioned_identity OK")
+
+
+def check_plan_ensemble_parity():
+    """The serve ensemble's traced-bind path consumes plans too: the
+    batched run program is bitwise-identical to the ad-hoc exchange
+    build (HEAT3D_NO_PLAN=1), and partitioned plans are member-wise
+    bitwise-identical to monolithic — on the hybrid b=2 x (2,1,1) mesh,
+    where the spatial ring and the batch axis coexist. Granularity
+    floor zeroed so the partitioned arm genuinely splits faces."""
+    import dataclasses
+    import os
+
+    from heat3d_tpu.parallel import plan as hplan
+
+    os.environ[hplan.ENV_PART_MIN_BYTES] = "0"
+    hplan.clear_plan_cache()
+    from heat3d_tpu.serve.ensemble import EnsembleSolver
+    from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+
+    def run_ensemble(halo_plan):
+        base = SolverConfig(
+            grid=GridConfig.cube(16),
+            mesh=MeshConfig(shape=(2, 1, 1)),
+            backend="jnp",
+            time_blocking=2,
+            halo_plan=halo_plan,
+        )
+        batch = ScenarioBatch(
+            base,
+            [
+                Scenario(alpha=0.3, bc_value=1.0, steps=5),
+                Scenario(alpha=0.5, steps=7),
+            ],
+        )
+        es = EnsembleSolver(batch, batch_mesh=2)
+        return es.gather(es.run(es.init_state(), None))
+
+    got = run_ensemble("monolithic")
+    os.environ["HEAT3D_NO_PLAN"] = "1"
+    try:
+        want = run_ensemble("monolithic")
+    finally:
+        del os.environ["HEAT3D_NO_PLAN"]
+    assert np.array_equal(got, want), (
+        "ensemble plan-built run != ad-hoc exchange build bitwise"
+    )
+    part = run_ensemble("partitioned")
+    assert np.array_equal(got, part), (
+        "ensemble partitioned != monolithic member-wise"
+    )
+    del os.environ[hplan.ENV_PART_MIN_BYTES]
+    print("plan_ensemble_parity OK")
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "plan":
+        # focused tier-1 entry (tests/test_plan.py runs it unmarked on a
+        # 4-device mesh): the persistent-exchange-plan acceptance battery
+        n = len(jax.devices())
+        assert n >= 4, f"expected >= 4 CPU devices, got {n}"
+        check_plan_bitwise_parity()
+        check_plan_partitioned_identity()
+        check_plan_ensemble_parity()
+        print("ALL MULTIDEVICE CHECKS PASSED")
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "deep_tb":
         # focused tier-1 entry (test_multidevice.py runs it unmarked on a
         # 4-device mesh; the full 8-device battery stays slow-marked)

@@ -568,7 +568,10 @@ def test_provenance_script_wrapper_delegates(tmp_path):
     good.write_text(
         json.dumps({"note": "foreign lines pass"}) + "\n"
         + json.dumps(
-            {"bench": "halo", "ts": "t", "platform": "cpu", "sync_rtt_s": 0.1}
+            {
+                "bench": "halo", "ts": "t", "platform": "cpu",
+                "sync_rtt_s": 0.1, "halo_plan": "monolithic",
+            }
         )
         + "\n"
     )

@@ -1,0 +1,508 @@
+"""Persistent halo-exchange plans (heat3d_tpu/parallel/plan.py): plan
+cache + audit-event contract, knob threading across the five surfaces,
+tuning-cache resolution, bench-row provenance, the partition-aware IR
+collective checks, and — the acceptance battery — bitwise plan-vs-ad-hoc
+parity plus partitioned-vs-monolithic value identity on a REAL 4-device
+CPU mesh subprocess (incl. the serve ensemble traced-bind path)."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.parallel import plan as hplan
+from heat3d_tpu.parallel.topology import abstract_mesh
+from heat3d_tpu.utils.compat import shard_map
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+SPEC = P("x", "y", "z")
+
+
+def _cfg(**kw):
+    kw.setdefault("grid", GridConfig.cube(16))
+    kw.setdefault("mesh", MeshConfig(shape=(2, 1, 1)))
+    kw.setdefault("backend", "jnp")
+    return SolverConfig(**kw)
+
+
+# ---- the acceptance battery: real 4-device CPU mesh -------------------------
+
+
+def test_plan_checks_on_cpu_mesh():
+    """Bitwise plan-vs-ad-hoc parity (7pt/27pt x tb{1..4} x
+    axis/pairwise), partitioned-vs-monolithic identity (incl. the uneven
+    decomposition and periodic wrap), and the ensemble traced-bind
+    parity — on a genuine 4-device CPU mesh subprocess."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    env["PYTHONPATH"] = os.pathsep.join([REPO, env.get("PYTHONPATH", "")])
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "multidevice_checks.py"), "plan"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"plan multidevice checks failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    for marker in (
+        "plan_bitwise_parity OK",
+        "plan_partitioned_identity OK",
+        "plan_ensemble_parity OK",
+    ):
+        assert marker in proc.stdout
+
+
+# ---- plan cache + audit events ----------------------------------------------
+
+
+def _read_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_plan_built_once_per_run_and_reused(tmp_path):
+    """The reuse contract: one ``exchange_plan_built`` per plan key per
+    run, however many executables trace it (the multistep ping-pong body
+    alone calls exchange() three times), with reuse recorded as
+    ``plan_cache_hit`` — and a SECOND run in the same process builds
+    nothing (the plan cache is persistent, not per-trace)."""
+    from heat3d_tpu import obs
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    hplan.clear_plan_cache()
+    p = str(tmp_path / "plan.ledger.jsonl")
+    obs.activate(p, meta={"entry": "test"})
+    try:
+        cfg = _cfg(mesh=MeshConfig(shape=(1, 1, 1)))
+        s = HeatSolver3D(cfg)
+        u = s.init_state("hot-cube")
+        u = s.run(u, jnp.int32(3))
+        # a second executable over the same exchange shape: residual step
+        s.step_with_residual(u)
+    finally:
+        obs.deactivate(rc=0)
+    events = _read_events(p)
+    built = [e for e in events if e["event"] == "exchange_plan_built"]
+    hits = [e for e in events if e["event"] == "plan_cache_hit"]
+    assert len(built) == 1, built
+    assert built[0]["mode"] == "monolithic"
+    assert built[0]["width"] == 1
+    assert built[0]["messages_per_exchange"] == 0  # (1,1,1): no remote party
+    assert len(hits) == 1  # deduped per (run, key), not per trace call
+    # second run, same process: the plan cache serves it — no new build
+    p2 = str(tmp_path / "plan2.ledger.jsonl")
+    obs.activate(p2, meta={"entry": "test"})
+    try:
+        s2 = HeatSolver3D(_cfg(mesh=MeshConfig(shape=(1, 1, 1))))
+        s2.run(s2.init_state("hot-cube"), jnp.int32(2))
+    finally:
+        obs.deactivate(rc=0)
+    events2 = _read_events(p2)
+    assert [e for e in events2 if e["event"] == "exchange_plan_built"] == []
+    assert [e for e in events2 if e["event"] == "plan_cache_hit"]
+
+
+def test_plan_traffic_model():
+    """The plan's transport model: messages double under partitioning,
+    boundary bytes do not (the A/B trades schedule, not traffic), and
+    axis ordering's progressive face extension is priced in."""
+    mesh = MeshConfig(shape=(2, 2, 1))
+    mono = hplan.build_plan(mesh, BoundaryCondition.DIRICHLET, width=1)
+    part = hplan.build_plan(
+        mesh, BoundaryCondition.DIRICHLET, width=1, mode="partitioned",
+        min_part_bytes=0,
+    )
+    tm = mono.traffic((8, 8, 16), 4)
+    tp = part.traffic((8, 8, 16), 4)
+    assert mono.messages_per_exchange() == 4  # 2 sharded axes x 2 faces
+    assert part.messages_per_exchange() == 8
+    assert tp["bytes_per_device"] == tm["bytes_per_device"]
+    assert tp["messages"] == 2 * tm["messages"]
+    # axis ordering: the y faces are x-extended (8+2) x 1 x 16
+    x_face = 8 * 16 * 4 * 2
+    y_face = (8 + 2) * 16 * 4 * 2
+    assert tm["bytes_per_device"] == x_face + y_face
+
+
+def test_partition_granularity_floor():
+    """Faces below the granularity floor ship whole (the monolithic
+    schedule) even under halo_plan='partitioned' — sub-messages too
+    small to pipeline are pure per-collective overhead (the CPU A/B's
+    measured regime; docs/TUNING.md)."""
+    mesh = MeshConfig(shape=(2, 1, 1))
+    gated = hplan.build_plan(
+        mesh, BoundaryCondition.DIRICHLET, mode="partitioned",
+        min_part_bytes=1 << 20,
+    )
+    # 16x16 fp32 face = 1 KiB < 1 MiB floor -> monolithic schedule
+    assert gated.traffic((16, 16, 16), 4)["messages"] == 2
+    # 1024^2 fp32 face = 4 MiB >= floor -> genuine sub-blocks
+    assert gated.traffic((1024, 1024, 1024), 4)["messages"] == 4
+    forced = hplan.build_plan(
+        mesh, BoundaryCondition.DIRICHLET, mode="partitioned",
+        min_part_bytes=0,
+    )
+    assert forced.traffic((16, 16, 16), 4)["messages"] == 4
+
+
+def test_partition_bounds_tile_exactly():
+    for extent, parts in ((16, 2), (7, 2), (3, 4), (1, 2)):
+        bounds = hplan.partition_bounds(extent, parts)
+        assert bounds[0][0] == 0 and bounds[-1][1] == extent
+        assert all(b > a for a, b in bounds)
+        assert all(
+            bounds[i][1] == bounds[i + 1][0] for i in range(len(bounds) - 1)
+        )
+
+
+# ---- config validation + kernel-route pinning -------------------------------
+
+
+def test_halo_plan_config_validation():
+    with pytest.raises(ValueError, match="halo_plan"):
+        _cfg(halo_plan="bogus")
+    with pytest.raises(ValueError, match="ppermute"):
+        _cfg(halo="dma", halo_plan="partitioned")
+    # auto + monolithic + partitioned all construct on ppermute
+    for hp in ("monolithic", "partitioned", "auto"):
+        assert _cfg(halo_plan=hp).halo_plan == hp
+
+
+def test_partitioned_pins_the_exchange_path(monkeypatch):
+    """halo_plan='partitioned' stands the kernel families down via the
+    shared gate (same contract as halo_order='pairwise'): the A/B must
+    measure the exchange path, never a kernel that ignores the knob."""
+    from heat3d_tpu.parallel.step import _direct_kernel_fn, _kernel_env_gate
+
+    monkeypatch.setenv("HEAT3D_DIRECT_INTERPRET", "1")
+    base = _cfg(backend="pallas", mesh=MeshConfig(shape=(1, 1, 1)))
+    assert _kernel_env_gate(base)[0] is True
+    part = dataclasses.replace(base, halo_plan="partitioned")
+    assert _kernel_env_gate(part)[0] is False
+    assert _direct_kernel_fn(part, halo=1) is None
+
+
+# ---- knob surfaces + tuning-cache resolution --------------------------------
+
+
+def test_halo_plan_on_every_knob_surface():
+    from heat3d_tpu.analysis.provenance import ROUTE_FIELDS
+    from heat3d_tpu.tune.cache import CONFIG_KNOBS
+    from heat3d_tpu.tune.space import (
+        DEFAULT_KNOBS,
+        check_concrete,
+        parse_knob_values,
+    )
+
+    assert "halo_plan" in CONFIG_KNOBS
+    assert DEFAULT_KNOBS["halo_plan"] == ("monolithic", "partitioned")
+    assert "halo_plan" in ROUTE_FIELDS
+    assert parse_knob_values("halo_plan", "monolithic,partitioned") == (
+        "monolithic",
+        "partitioned",
+    )
+    with pytest.raises(ValueError, match="concrete"):
+        parse_knob_values("halo_plan", "auto")
+    with pytest.raises(ValueError, match="concrete"):
+        check_concrete({"halo_plan": ("auto",)})
+
+
+def test_halo_plan_resolves_through_tune_cache(tmp_path):
+    """halo_plan='auto' resolves to the cached winner; an entry
+    predating the knob (schema drift) degrades to the static fallback
+    (monolithic) instead of crashing resolution."""
+    from heat3d_tpu.tune import cache as tcache
+
+    store = str(tmp_path / "tune_cache.json")
+    base = _cfg(mesh=MeshConfig(shape=(1, 1, 1)))
+    winner = dataclasses.replace(base, halo_plan="partitioned")
+    key = tcache.cache_key(base)
+    tcache.store_entry(key, winner, 1.0, path=store)
+    resolved = tcache.resolve_config(
+        dataclasses.replace(base, halo_plan="auto"), path=store
+    )
+    assert resolved.halo_plan == "partitioned"
+    # explicit knobs are never overridden
+    explicit = tcache.resolve_config(base, path=store)
+    assert explicit.halo_plan == "monolithic"
+    # legacy entry missing the knob -> stale -> static fallback
+    doc = json.load(open(store))
+    del doc["entries"][key]["config"]["halo_plan"]
+    json.dump(doc, open(store, "w"))
+    legacy = tcache.resolve_config(
+        dataclasses.replace(base, halo_plan="auto"), path=store
+    )
+    assert legacy.halo_plan == "monolithic"
+
+
+def test_tune_apply_and_show_annotate_partitioned(tmp_path, capsys):
+    from heat3d_tpu.tune import cache as tcache
+    from heat3d_tpu.tune.cli import main as tune_main
+
+    store = str(tmp_path / "tune_cache.json")
+    base = _cfg(mesh=MeshConfig(shape=(1, 1, 1)))
+    winner = dataclasses.replace(base, halo_plan="partitioned")
+    key = tcache.cache_key(base)
+    tcache.store_entry(key, winner, 2.0, default_metric=1.5, path=store)
+    assert tune_main(["apply", "--key", key, "--cache", store]) == 0
+    out = capsys.readouterr().out
+    assert "--halo-plan partitioned" in out
+    assert tune_main(["show", "--cache", store]) == 0
+    out = capsys.readouterr().out
+    assert "partitioned-exchange winner" in out
+
+
+# ---- bench-row provenance ---------------------------------------------------
+
+
+def test_bench_rows_carry_halo_plan(tmp_path):
+    from heat3d_tpu.bench.harness import bench_halo, bench_throughput
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_provenance as cp
+    finally:
+        sys.path.pop(0)
+    cfg = _cfg(grid=GridConfig.cube(8), mesh=MeshConfig(shape=(1, 1, 1)))
+    row = bench_throughput(cfg, steps=2, warmup=1, repeats=1)
+    assert row["halo_plan"] == "monolithic"
+    assert cp.check_row(row) == []
+    halo = bench_halo(
+        dataclasses.replace(cfg, halo_plan="partitioned"),
+        iters=2, warmup=1, k=2,
+    )
+    assert halo["halo_plan"] == "partitioned"
+    # the plan's own transport model rides the row (planned-exchange arm)
+    assert halo["plan_messages_per_exchange"] == 0  # (1,1,1): no ICI
+    assert halo["plan_bytes_per_device"] == 0
+    assert cp.check_row(halo) == []
+    legacy = dict(halo)
+    legacy.pop("halo_plan")
+    assert any("halo_plan" in p for p in cp.check_row(legacy))
+
+
+def test_no_plan_escape_records_effective_mode(monkeypatch):
+    """Under HEAT3D_NO_PLAN=1 a requested-partitioned config executes
+    the ad-hoc monolithic schedule — rows and sweep-journal keys must
+    record THAT, or the escape hatch corrupts the plan A/B (review
+    finding)."""
+    from heat3d_tpu.bench.harness import bench_halo
+    from heat3d_tpu.parallel.plan import effective_halo_plan
+    from heat3d_tpu.resilience.sweepstate import row_key
+
+    cfg = _cfg(
+        grid=GridConfig.cube(8), mesh=MeshConfig(shape=(1, 1, 1)),
+        halo_plan="partitioned",
+    )
+    assert effective_halo_plan(cfg) == "partitioned"
+    assert ":hppartitioned" in row_key(cfg, "halo")
+    monkeypatch.setenv("HEAT3D_NO_PLAN", "1")
+    assert effective_halo_plan(cfg) == "monolithic"
+    assert ":hppartitioned" not in row_key(cfg, "halo")
+    row = bench_halo(cfg, iters=2, warmup=1, k=2)
+    assert row["halo_plan"] == "monolithic"
+
+
+def test_roofline_path_labels_partitioned_rows():
+    from heat3d_tpu.obs.perf.roofline import bytes_per_cell_update
+
+    row = {
+        "dtype": "float32", "time_blocking": 1, "mesh": [2, 1, 1],
+        "halo": "ppermute", "direct_path": False,
+        "halo_plan": "partitioned",
+    }
+    per_update, path = bytes_per_cell_update(row)
+    assert "planned-partitioned" in path
+    row_mono = dict(row, halo_plan="monolithic")
+    per_mono, path_mono = bytes_per_cell_update(row_mono)
+    assert per_update == per_mono  # same bytes — the A/B trades schedule
+    assert "planned" not in path_mono
+
+
+# ---- partition-aware IR collective checks -----------------------------------
+
+
+def _ir_case(fn, cfg, key="seed-plan"):
+    from heat3d_tpu.analysis.ir import programs as irp
+
+    aval = jax.ShapeDtypeStruct(
+        cfg.padded_shape, jnp.dtype(cfg.precision.storage)
+    )
+    return irp.ProgramCase(
+        key=key,
+        cfg=cfg,
+        kind="step",
+        path="tests/seeded.py",
+        fn=fn,
+        avals=(aval,),
+        mesh_sizes=dict(zip(cfg.mesh.axis_names, cfg.mesh.shape)),
+    )
+
+
+def _sharded(fn, cfg, out_specs=SPEC):
+    return shard_map(
+        fn,
+        mesh=abstract_mesh(cfg.mesh),
+        in_specs=SPEC,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+
+
+def test_ir_accepts_partitioned_step_program(monkeypatch):
+    """A REAL plan-built partitioned step program (granularity floor
+    zeroed, so 16^3 faces genuinely split) certifies clean through the
+    collective-topology family (sub-block permutes compose to the
+    inverse-pair ring shifts, face sub-blocks tile the contracted
+    extents) — and it really traces MORE than the 2-per-axis monolithic
+    permute count."""
+    from heat3d_tpu.analysis.ir import collectives as irc, jaxpr_tools as jt
+    from heat3d_tpu.parallel.step import make_step_fn
+
+    monkeypatch.setenv(hplan.ENV_PART_MIN_BYTES, "0")
+    hplan.clear_plan_cache()
+    cfg = _cfg(halo_plan="partitioned", mesh=MeshConfig(shape=(2, 2, 1)))
+    case = _ir_case(
+        make_step_fn(cfg, abstract_mesh(cfg.mesh)), cfg,
+        key="plan-partitioned-clean",
+    )
+    pp = [
+        s
+        for s in jt.collect_collectives(case.jaxpr())
+        if s.prim == "ppermute"
+    ]
+    assert len(pp) == 8  # 2 sharded axes x 2 faces x 2 sub-blocks
+    findings = [
+        f
+        for f in irc.check_cases([case])
+        if f.code in ("ANL601", "ANL602", "ANL603", "ANL604", "ANL605")
+    ]
+    assert findings == [], [f.message for f in findings]
+
+
+def test_ir_accepts_partitioned_periodic_size2_ring(monkeypatch):
+    """On a periodic size-2 ring shift(+1) == shift(-1) (self-inverse),
+    so BOTH face directions' sub-blocks land in one permutation class —
+    the tile-sum rule must accept them covering the extent exactly twice
+    (review finding: this fired a false ANL604 on a provably
+    bitwise-correct program)."""
+    from heat3d_tpu.analysis.ir import collectives as irc
+    from heat3d_tpu.parallel.step import make_step_fn
+
+    monkeypatch.setenv(hplan.ENV_PART_MIN_BYTES, "0")
+    hplan.clear_plan_cache()
+    cfg = _cfg(
+        halo_plan="partitioned",
+        stencil=StencilConfig(bc=BoundaryCondition.PERIODIC),
+    )
+    case = _ir_case(
+        make_step_fn(cfg, abstract_mesh(cfg.mesh)), cfg,
+        key="plan-partitioned-periodic2",
+    )
+    findings = [
+        f
+        for f in irc.check_cases([case])
+        if f.code in ("ANL601", "ANL602", "ANL603", "ANL604", "ANL605")
+    ]
+    assert findings == [], [f.message for f in findings]
+
+
+def test_ir_flags_unbalanced_partitioned_directions():
+    """A sub-block shipped one way and never returned is an unmatched
+    transfer: ANL605 direction-balance fires (the partitioned analogue
+    of a missing face)."""
+    from heat3d_tpu.analysis.ir import collectives as irc
+    from heat3d_tpu.parallel.halo import shift_perm
+
+    cfg = _cfg(halo_plan="partitioned")
+    up = shift_perm(2, +1, False)
+    down = shift_perm(2, -1, False)
+
+    def bad(u):
+        hi = u[-1:]
+        lo = u[:1]
+        # two sub-blocks up, only ONE down: unbalanced directions
+        g1 = lax.ppermute(hi[:, :8], "x", up)
+        g2 = lax.ppermute(hi[:, 8:], "x", up)
+        g3 = lax.ppermute(lo, "x", down)
+        return u + g1.sum() + g2.sum() + g3.sum()
+
+    findings = irc.check_cases([_ir_case(_sharded(bad, cfg), cfg)])
+    msgs = [f.message for f in findings if f.code == "ANL605"]
+    assert any("balanced" in m for m in msgs), [f.message for f in findings]
+
+
+def test_ir_flags_partitions_that_do_not_tile_the_face():
+    """Partitioned sub-blocks must tile the contracted face extent
+    exactly — two 6-wide strips of a 16-wide face (a gap) fire ANL604."""
+    from heat3d_tpu.analysis.ir import collectives as irc
+    from heat3d_tpu.parallel.halo import shift_perm
+
+    cfg = _cfg(halo_plan="partitioned")
+    up = shift_perm(2, +1, False)
+    down = shift_perm(2, -1, False)
+
+    def gappy(u):
+        hi = u[-1:]
+        lo = u[:1]
+        acc = u * 1.0
+        for a, b in ((0, 6), (6, 12)):  # 12 of 16 covered — gap
+            acc = acc + lax.ppermute(hi[:, a:b], "x", up).sum()
+            acc = acc + lax.ppermute(lo[:, a:b], "x", down).sum()
+        return acc
+
+    findings = irc.check_cases([_ir_case(_sharded(gappy, cfg), cfg)])
+    assert "ANL604" in {f.code for f in findings}, [
+        f.message for f in findings
+    ]
+
+
+def test_ir_monolithic_still_rejects_multiplicity():
+    """The partitioned allowance is gated on the plan mode: the same
+    sub-block multiplicity on a MONOLITHIC program stays an ANL605."""
+    from heat3d_tpu.analysis.ir import collectives as irc
+    from heat3d_tpu.parallel.halo import shift_perm
+
+    cfg = _cfg()  # halo_plan='monolithic'
+    up = shift_perm(2, +1, False)
+    down = shift_perm(2, -1, False)
+
+    def split(u):
+        hi = u[-1:]
+        lo = u[:1]
+        acc = u * 1.0
+        for a, b in ((0, 8), (8, 16)):
+            acc = acc + lax.ppermute(hi[:, a:b], "x", up).sum()
+            acc = acc + lax.ppermute(lo[:, a:b], "x", down).sum()
+        return acc
+
+    findings = irc.check_cases([_ir_case(_sharded(split, cfg), cfg)])
+    msgs = [f.message for f in findings if f.code == "ANL605"]
+    assert any("MONOLITHIC" in m for m in msgs), [
+        f.message for f in findings
+    ]

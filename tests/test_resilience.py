@@ -616,6 +616,7 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
         "platform": "tpu", "direct_path": True, "mehrstellen_route": False,
         "fused_dma_path": False, "fused_dma_emulated": False,
         "streamk_path": False, "streamk_emulated": False,
+        "halo_plan": "monolithic",
         "chain_ops": 7, "backend": "auto", "sync_rtt_s": 7.5e-2,
         # ensemble-workload provenance (PR 7): required on every
         # throughput row — solo rows carry [1]/1
@@ -623,7 +624,7 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
     }
     halo_good = {
         "bench": "halo", "ts": "2026-01-01T00:00:00Z", "platform": "tpu",
-        "sync_rtt_s": 7.5e-2,
+        "sync_rtt_s": 7.5e-2, "halo_plan": "monolithic",
     }
     rows = [
         good,

@@ -434,7 +434,7 @@ def test_solo_throughput_rows_carry_solo_batch_fields():
         "chain_ops": "x", "mehrstellen_route": False,
         "direct_path": False, "fused_dma_path": False,
         "fused_dma_emulated": False, "streamk_path": False,
-        "streamk_emulated": False,
+        "streamk_emulated": False, "halo_plan": "monolithic",
         "batch_shape": [1], "members_per_step": 1,
     }
     assert check_row(row) == []
