@@ -1,6 +1,6 @@
 """Static-analysis subsystem: SPMD-safety and invariant lints.
 
-Five AST/arithmetic checkers over the repo's own source (docs/ANALYSIS.md
+Six AST/arithmetic checkers over the repo's own source (docs/ANALYSIS.md
 is the catalog), one shared finding/severity/suppression framework
 (:mod:`~heat3d_tpu.analysis.findings`), the promoted data-lint cores
 behind ``scripts/check_ledger.py`` / ``scripts/check_provenance.py``,
@@ -33,4 +33,5 @@ CHECKERS = {
     "vmem-budget": "heat3d_tpu.analysis.vmem",
     "ledger-taxonomy": "heat3d_tpu.analysis.taxonomy",
     "knob-drift": "heat3d_tpu.analysis.knobs",
+    "eqn-registry": "heat3d_tpu.analysis.eqnlint",
 }

@@ -53,6 +53,16 @@ def check_row(r: dict) -> list:
         for f in ROUTE_FIELDS:
             if f not in r:
                 problems.append(f"missing route-provenance field {f!r}")
+        # equation-family provenance (PR 11): families share stencil
+        # footprints but not chains or stability envelopes — a
+        # spec-built family's rate must be keyable from the row alone
+        # so it never cross-compares with (or masquerades as) heat
+        if not (isinstance(r.get("equation"), str) and r["equation"]):
+            problems.append(
+                "equation missing/empty (equation-family provenance — "
+                "obs regress keys baselines on it; legacy rows key to "
+                "heat)"
+            )
         if "chain_ops" not in r:
             problems.append("missing route-provenance field 'chain_ops'")
         elif r["chain_ops"] is None and r.get("backend") != "conv":

@@ -204,6 +204,11 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
                            "desc": "27pt separable-decomposition route"},
     "HEAT3D_NO_DIRECT": {"module": "parallel/step.py, ops/stencil_pallas.py",
                          "desc": "1 disables the direct kernel routes"},
+    "HEAT3D_EQN_LEGACY": {"module": "eqn/__init__.py",
+                          "desc": "1 routes the heat family through the "
+                                  "verbatim pre-spec tap derivation (the "
+                                  "eqn bitwise parity reference arm; "
+                                  "non-heat families reject it)"},
     "HEAT3D_NO_PLAN": {"module": "parallel/plan.py",
                        "desc": "1 bypasses the exchange-plan layer (legacy "
                                "ad-hoc dispatch; partitioned degrades to "

@@ -146,6 +146,12 @@ def bench_throughput(
         "platform": jax.default_backend(),
         "grid": list(cfg.grid.shape),
         "stencil": cfg.stencil.kind,
+        # equation-family provenance (REQUIRED by check_provenance.py on
+        # every throughput row): families share footprints but not
+        # chains/stability envelopes, so a reaction-diffusion rate must
+        # never baseline against — or masquerade as — a heat rate
+        # (obs regress keys on it; legacy rows key to heat)
+        "equation": cfg.equation,
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "compute_dtype": cfg.precision.compute,
@@ -318,6 +324,14 @@ def _chain_ops(cfg: SolverConfig, mehrstellen: bool = None) -> int:
         mehrstellen = _mehrstellen_route(cfg)
     if mehrstellen:
         return MEHRSTELLEN_OPS
+    if cfg.equation != "heat":
+        # spec-built families: count the ACTUAL lowered chain (asymmetric
+        # taps — e.g. advection — defeat the x/y factoring, so the heat
+        # kind's nominal count would misstate the emitted ops)
+        from heat3d_tpu.core.stencils import effective_num_taps
+        from heat3d_tpu.parallel.step import _solver_taps
+
+        return effective_num_taps(_solver_taps(cfg))
     return chain_ops_for(cfg.stencil.kind)
 
 

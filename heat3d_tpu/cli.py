@@ -55,6 +55,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--alpha", type=float, default=1.0, help="thermal diffusivity")
     p.add_argument("--dt", type=float, default=None, help="time step (default 0.9x stable)")
     p.add_argument("--stencil", choices=["7pt", "27pt"], default="7pt")
+    # equation-family choices come from the LIVE registry (heat3d_tpu.eqn)
+    # — the eqn-registry lint (ANL521) cross-checks this stays true
+    from heat3d_tpu.eqn import FAMILIES
+
+    p.add_argument(
+        "--equation", choices=sorted(FAMILIES), default="heat",
+        help="equation family the tap compiler lowers onto the stencil "
+        "footprint (heat3d eqn list; docs/EQUATIONS.md). 'heat' is the "
+        "legacy path, spec-authored",
+    )
+    p.add_argument(
+        "--eq-param", action="append", default=[], metavar="NAME=VALUE",
+        help="equation-family parameter override (repeatable), e.g. "
+        "--eq-param vx=2.0; defaults per `heat3d eqn show FAMILY`",
+    )
     p.add_argument("--bc", choices=["dirichlet", "periodic"], default="dirichlet")
     p.add_argument("--bc-value", type=float, default=0.0)
     p.add_argument(
@@ -210,7 +225,15 @@ def config_from_args(args) -> SolverConfig:
         time_blocking=args.time_blocking,
         halo_order=args.halo_order,
         halo_plan=args.halo_plan,
+        equation=getattr(args, "equation", "heat"),
+        eq_params=_parse_eq_params(getattr(args, "eq_param", [])),
     )
+
+
+def _parse_eq_params(pairs) -> tuple:
+    from heat3d_tpu.eqn.cli import parse_eq_params
+
+    return parse_eq_params(pairs)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -241,6 +264,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from heat3d_tpu.serve.cli import main as serve_main
 
         return serve_main(argv_l[1:])
+    # `heat3d eqn ...` — the declarative equation registry's inspection
+    # surface (list/show; docs/EQUATIONS.md), dispatched like `obs`/`tune`
+    if argv_l and argv_l[0] == "eqn":
+        from heat3d_tpu.eqn.cli import main as eqn_main
+
+        return eqn_main(argv_l[1:])
     # A measurement script stopping this run with `timeout` (SIGTERM) must
     # release the axon pool's chip claim on the way out, not die holding it.
     from heat3d_tpu.utils.backendprobe import install_sigterm_exit
@@ -285,6 +314,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
         "run_start",
         grid=list(cfg.grid.shape),
         stencil=cfg.stencil.kind,
+        equation=cfg.equation,
         mesh=list(cfg.mesh.shape),
         dtype=cfg.precision.storage,
         backend=cfg.backend,
@@ -632,6 +662,7 @@ def _finish(
     summary = {
         "grid": list(cfg.grid.shape),
         "stencil": cfg.stencil.kind,
+        "equation": cfg.equation,
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "backend": cfg.backend,
@@ -657,9 +688,15 @@ def _finish(
         # steps_done counts from t=0 even on --resume: the golden model must
         # advance the original init by the run's TOTAL step count, not just
         # the resumed segment.
+        # the fp64 oracle steps the SPEC-compiled taps (identical to the
+        # legacy derivation for heat), so --golden-check covers every
+        # equation family, not just heat (docs/EQUATIONS.md)
+        from heat3d_tpu import eqn
+
         g = golden.run(
             golden.make_init(args.init, cfg.grid.shape, seed=cfg.run.seed),
             cfg.grid, cfg.stencil, steps_done,
+            taps=eqn.solver_taps(cfg),
         )
         got = solver.gather(u).astype(np.float64)
         err = float(np.max(np.abs(got - g)))

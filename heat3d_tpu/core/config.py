@@ -244,8 +244,29 @@ class SolverConfig:
     # stand down — and requires the ppermute transport), or 'auto'
     # (resolve through the tuning cache, static fallback monolithic).
     halo_plan: str = "monolithic"
+    # Equation family (heat3d_tpu.eqn registry; docs/EQUATIONS.md):
+    # which PDE the tap compiler lowers onto the stencil footprint.
+    # 'heat' is the legacy hardcoded path, now spec-authored — its
+    # lowered taps are bit-identical to stencil_taps by construction.
+    # The family + eq_params select the OPERATOR; stencil.kind stays the
+    # footprint/accuracy knob (families declare which kinds they
+    # support), and everything downstream of the taps (halo plans,
+    # supersteps, tuner, serve, IR certification) is equation-agnostic.
+    equation: str = "heat"
+    # Family parameter overrides as (name, value) pairs — hashable, so
+    # configs stay usable as dict keys. Unknown names fail validation;
+    # unset names take the family defaults (heat3d eqn show FAMILY).
+    eq_params: Tuple[Tuple[str, float], ...] = ()
 
     def __post_init__(self):
+        if not isinstance(self.eq_params, tuple):
+            # normalize list-of-pairs input (CLI/json surfaces) to the
+            # hashable canonical form
+            object.__setattr__(
+                self,
+                "eq_params",
+                tuple((str(k), float(v)) for k, v in self.eq_params),
+            )
         if self.halo not in ("ppermute", "dma", "auto"):
             raise ValueError(f"unknown halo transport {self.halo!r}")
         if self.time_blocking < 0:
@@ -291,6 +312,11 @@ class SolverConfig:
                     "transport; the DMA exchange kernels implement "
                     "axis-ordered propagation"
                 )
+        # equation-family validation (unknown family/params, unsupported
+        # stencil kind) — lazy import like StencilConfig's STENCILS check
+        from heat3d_tpu import eqn
+
+        eqn.validate_config(self)
         if self.is_padded and self.stencil.bc is BoundaryCondition.PERIODIC:
             raise ValueError(
                 f"grid {self.grid.shape} is not divisible by mesh "

@@ -48,6 +48,7 @@ def run(
     stencil: StencilConfig,
     num_steps: int,
     impl: str = "auto",
+    taps: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """num_steps golden updates; float64 throughout.
 
@@ -56,10 +57,19 @@ def run(
     reference's serial path, ~100x faster at large grids), or 'auto'
     (native when built, else numpy). Both produce identical float64 math;
     tests/test_native.py holds them to tight agreement.
+
+    ``taps`` overrides the derived heat taps — the declarative equation
+    families (heat3d_tpu.eqn) pass their spec-compiled taps through here,
+    so every family gets the same fp64 oracle (both steppers are
+    tap-generic; the stencil arg then only supplies the BC).
     """
-    taps = stencil_taps(
-        STENCILS[stencil.kind], grid.alpha, grid.effective_dt(), grid.spacing
-    )
+    if taps is None:
+        taps = stencil_taps(
+            STENCILS[stencil.kind],
+            grid.alpha,
+            grid.effective_dt(),
+            grid.spacing,
+        )
     if impl not in ("auto", "numpy", "native"):
         raise ValueError(f"unknown impl {impl!r}")
     if impl in ("auto", "native"):
@@ -81,6 +91,50 @@ def run(
     for _ in range(num_steps):
         u = step(u, taps, stencil.bc, stencil.bc_value)
     return u
+
+
+def plane_wave(
+    shape: Tuple[int, int, int],
+    spacing: Tuple[float, float, float],
+    wave: Tuple[int, int, int],
+    t: float = 0.0,
+    mu: float = 0.0,
+    omega: float = 0.0,
+) -> np.ndarray:
+    """The periodic plane-wave manufactured solution, fp64:
+
+        u(x, t) = exp(-mu t) * sin(k . x - omega t)
+
+    with ``k_a = 2*pi*wave_a / (shape_a * spacing_a)`` — integer mode
+    numbers, so the wave is exactly periodic on the grid (cell centers at
+    ``x_a = i * spacing_a``). Every shipped equation family is linear
+    with constant coefficients, so a single plane wave is an EXACT
+    continuous solution with family-specific rates ``(mu, omega)``
+    (``eqn.mms_rates``) — the MMS oracle for the per-family
+    convergence-order tests (tests/test_eqn.py) and the e2e family
+    certification on a real device mesh (tests/multidevice_checks.py)."""
+    k = [
+        2.0 * np.pi * w / (n * h) for w, n, h in zip(wave, shape, spacing)
+    ]
+    axes = [
+        np.arange(n, dtype=np.float64) * h for n, h in zip(shape, spacing)
+    ]
+    xx, yy, zz = np.meshgrid(*axes, indexing="ij")
+    phase = k[0] * xx + k[1] * yy + k[2] * zz - omega * t
+    return np.exp(-mu * t) * np.sin(phase)
+
+
+def wavevector(
+    shape: Tuple[int, int, int],
+    spacing: Tuple[float, float, float],
+    wave: Tuple[int, int, int],
+) -> Tuple[float, float, float]:
+    """The physical wavevector of integer mode numbers ``wave`` on this
+    periodic grid — what :func:`plane_wave` uses and what
+    ``eqn.mms_rates`` wants as input (one derivation, shared)."""
+    return tuple(
+        2.0 * np.pi * w / (n * h) for w, n, h in zip(wave, shape, spacing)
+    )
 
 
 def residual_norm(u_new: np.ndarray, u_old: np.ndarray) -> float:

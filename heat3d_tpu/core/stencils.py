@@ -79,6 +79,41 @@ def _twenty_seven_point() -> Stencil:
 STENCILS: Dict[str, Stencil] = {s.name: s for s in (_seven_point(), _twenty_seven_point())}
 
 
+def scaled_laplacian(
+    weights: np.ndarray,
+    spacing: Tuple[float, float, float],
+    separable: bool,
+    name: str = "stencil",
+) -> np.ndarray:
+    """Scale 3x3x3 Laplacian-like weights by the grid spacing: the
+    spatial-operator half of :func:`stencil_taps`, factored out so the
+    declarative equation compiler (heat3d_tpu.eqn) lowers its diffusion
+    terms through the EXACT float arithmetic the legacy path runs (the
+    spec-vs-hardcoded bitwise contract rides on this body being shared).
+
+    Separable weights get per-axis 1/h_axis^2 on the axis taps with the
+    center rebalanced to keep rows summing to the same Laplacian;
+    non-separable weights require uniform spacing."""
+    hx, hy, hz = spacing
+    w = weights
+    if separable:
+        scale = np.zeros((3, 3, 3))
+        # axis taps live where exactly one index differs from center
+        scale[0, 1, 1] = scale[2, 1, 1] = 1.0 / hx**2
+        scale[1, 0, 1] = scale[1, 2, 1] = 1.0 / hy**2
+        scale[1, 1, 0] = scale[1, 1, 2] = 1.0 / hz**2
+        # center balances so rows still sum to the same Laplacian
+        lap = w * scale
+        lap[1, 1, 1] = -(lap.sum() - lap[1, 1, 1])
+    else:
+        if not (hx == hy == hz):
+            raise ValueError(
+                f"stencil {name!r} requires uniform spacing, got {spacing}"
+            )
+        lap = w / hx**2
+    return lap
+
+
 def stencil_taps(
     stencil: Stencil,
     alpha: float,
@@ -93,23 +128,9 @@ def stencil_taps(
     c1x/c1y/c1z coefficients, SURVEY.md §2 C1); non-separable stencils
     require uniform spacing.
     """
-    hx, hy, hz = spacing
-    w = stencil.weights
-    if stencil.separable:
-        scale = np.zeros((3, 3, 3))
-        # axis taps live where exactly one index differs from center
-        scale[0, 1, 1] = scale[2, 1, 1] = 1.0 / hx**2
-        scale[1, 0, 1] = scale[1, 2, 1] = 1.0 / hy**2
-        scale[1, 1, 0] = scale[1, 1, 2] = 1.0 / hz**2
-        # center balances so rows still sum to the same Laplacian
-        lap = w * scale
-        lap[1, 1, 1] = -(lap.sum() - lap[1, 1, 1])
-    else:
-        if not (hx == hy == hz):
-            raise ValueError(
-                f"stencil {stencil.name!r} requires uniform spacing, got {spacing}"
-            )
-        lap = w / hx**2
+    lap = scaled_laplacian(
+        stencil.weights, spacing, stencil.separable, name=stencil.name
+    )
     taps = dt * alpha * lap
     taps[1, 1, 1] += 1.0
     return taps

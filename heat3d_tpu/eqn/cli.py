@@ -1,0 +1,183 @@
+"""``heat3d eqn`` — inspect the declarative equation registry.
+
+    heat3d eqn list [--json]          # family table (name, kinds, params)
+    heat3d eqn show FAMILY [--json]   # spec detail + nominal lowered taps
+                 [--stencil 7pt|27pt] [--eq-param NAME=VALUE ...]
+                 [--alpha A] [--dt DT] [--spacing HX HY HZ]
+
+``show`` compiles the family at the given (or nominal) coefficients and
+prints the spec terms, the lowered 3x3x3 update taps, the tap footprint,
+and the tune-cache fingerprint leg — the authoring feedback loop for new
+families (docs/EQUATIONS.md "Authoring guide").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def parse_eq_params(pairs: List[str]) -> tuple:
+    """``NAME=VALUE`` strings -> the canonical eq_params tuple (shared by
+    the solver CLI's --eq-param and this one)."""
+    out = []
+    for s in pairs or []:
+        name, sep, val = s.partition("=")
+        if not sep or not name:
+            raise ValueError(
+                f"--eq-param wants NAME=VALUE, got {s!r}"
+            )
+        try:
+            out.append((name, float(val)))
+        except ValueError:
+            raise ValueError(
+                f"--eq-param {name}: value {val!r} is not a number"
+            ) from None
+    return tuple(out)
+
+
+def _family_record(fam) -> dict:
+    return {
+        "name": fam.name,
+        "kinds": list(fam.kinds),
+        "params": {k: v for k, v in fam.defaults},
+        "description": fam.description,
+    }
+
+
+def cmd_list(args) -> int:
+    from heat3d_tpu.eqn import FAMILIES
+
+    if args.json:
+        print(json.dumps([_family_record(f) for f in FAMILIES.values()]))
+        return 0
+    print(f"{len(FAMILIES)} equation families (docs/EQUATIONS.md):")
+    for fam in FAMILIES.values():
+        params = (
+            ", ".join(f"{k}={v:g}" for k, v in fam.defaults) or "(none)"
+        )
+        print(f"  {fam.name:<20} kinds={'/'.join(fam.kinds):<9} {params}")
+        print(f"  {'':<20} {fam.description}")
+    return 0
+
+
+def cmd_show(args) -> int:
+    from heat3d_tpu.core.config import (
+        GridConfig,
+        SolverConfig,
+        StencilConfig,
+    )
+    from heat3d_tpu.eqn import FAMILIES, build_spec, fingerprint
+
+    fam = FAMILIES.get(args.family)
+    if fam is None:
+        print(
+            f"heat3d eqn: unknown family {args.family!r}; have "
+            f"{sorted(FAMILIES)}",
+            file=sys.stderr,
+        )
+        return 2
+    kind = args.stencil or fam.kinds[0]
+    cfg = SolverConfig(
+        grid=GridConfig.cube(
+            16, alpha=args.alpha, dt=args.dt, spacing=tuple(args.spacing)
+        ),
+        stencil=StencilConfig(kind=kind),
+        equation=fam.name,
+        eq_params=parse_eq_params(args.eq_param),
+    )
+    spec = build_spec(cfg)
+    from heat3d_tpu import eqn
+
+    taps = eqn.solver_taps(cfg)
+    merged = eqn.resolved_params(cfg)
+    from heat3d_tpu.core.stencils import nonzero_taps
+
+    taps_list = [
+        {"offset": list(off), "weight": w} for off, w in nonzero_taps(taps)
+    ]
+    record = {
+        **_family_record(fam),
+        "stencil": kind,
+        "alpha": args.alpha,
+        "dt": cfg.grid.effective_dt(),
+        "spacing": list(cfg.grid.spacing),
+        # the EFFECTIVE parameter set (defaults + overrides, the one
+        # resolution rule — eqn.resolved_params), plus the raw overrides
+        # for callers reconstructing the command line
+        "eq_params": merged,
+        "eq_param_overrides": {k: v for k, v in cfg.eq_params},
+        "terms": [
+            {
+                "name": t.name,
+                "coeff": t.coeff,
+                "scaling": t.op.scaling,
+                "num_taps": int(np.count_nonzero(t.op.weights)),
+            }
+            for t in spec.terms
+        ],
+        "taps": taps_list,
+        "num_taps": len(taps_list),
+        "fingerprint": fingerprint(cfg),
+    }
+    if args.json:
+        print(json.dumps(record))
+        return 0
+    print(f"{fam.name} ({kind}): {fam.description}")
+    print(
+        f"  alpha={args.alpha:g} dt={record['dt']:g} "
+        f"spacing={tuple(cfg.grid.spacing)}"
+    )
+    if merged:
+        print(
+            "  params: "
+            + " ".join(f"{k}={v:g}" for k, v in sorted(merged.items()))
+        )
+    for t in record["terms"]:
+        print(
+            f"  term {t['name']:<12} coeff={t['coeff']:g} "
+            f"scaling={t['scaling']} taps={t['num_taps']}"
+        )
+    print(f"  lowered update taps ({record['num_taps']} nonzero):")
+    for t in taps_list:
+        off = tuple(t["offset"])
+        print(f"    {off!s:<12} {t['weight']: .12g}")
+    print(f"  tune-cache fingerprint leg: {record['fingerprint']}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="heat3d eqn",
+        description="inspect the declarative equation registry "
+        "(docs/EQUATIONS.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pl = sub.add_parser("list", help="the family table")
+    pl.add_argument("--json", action="store_true")
+    pl.set_defaults(fn=cmd_list)
+    ps = sub.add_parser("show", help="one family's spec + lowered taps")
+    ps.add_argument("family")
+    ps.add_argument("--stencil", choices=["7pt", "27pt"], default=None)
+    ps.add_argument("--eq-param", action="append", default=[],
+                    metavar="NAME=VALUE")
+    ps.add_argument("--alpha", type=float, default=1.0)
+    ps.add_argument("--dt", type=float, default=None)
+    ps.add_argument("--spacing", type=float, nargs=3,
+                    default=[1.0, 1.0, 1.0])
+    ps.add_argument("--json", action="store_true")
+    ps.set_defaults(fn=cmd_show)
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except ValueError as e:
+        print(f"heat3d eqn: error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,10 @@ def row_key(row: Dict[str, Any]) -> Optional[Tuple]:
         return (
             "throughput",
             row.get("stencil", "7pt"),
+            # equation-family leg: spec-built families (PR 11) never
+            # cross-compare with heat — rows predating the field are
+            # heat by construction (only heat existed)
+            row.get("equation", "heat"),
             tuple(row.get("grid") or ()),
             tuple(row.get("mesh") or ()),
             row.get("dtype"),

@@ -25,7 +25,7 @@ from heat3d_tpu.core.config import (
     Precision,
     SolverConfig,
 )
-from heat3d_tpu.core.stencils import STENCILS, effective_num_taps, stencil_taps
+from heat3d_tpu.core.stencils import STENCILS, effective_num_taps
 from heat3d_tpu.obs.trace import named_phase, scoped
 from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
 from heat3d_tpu.utils.compat import shard_map
@@ -47,12 +47,14 @@ def _log_step_path_once(msg: str) -> None:
 
 
 def _solver_taps(cfg: SolverConfig) -> np.ndarray:
-    return stencil_taps(
-        STENCILS[cfg.stencil.kind],
-        cfg.grid.alpha,
-        cfg.grid.effective_dt(),
-        cfg.grid.spacing,
-    )
+    """The config's update taps, via the declarative equation frontend
+    (heat3d_tpu.eqn): the spec compiler lowers ``cfg.equation`` onto the
+    stencil footprint — bit-identical to the old inline ``stencil_taps``
+    call for the heat family (docs/EQUATIONS.md; ``HEAT3D_EQN_LEGACY=1``
+    inside eqn keeps the verbatim legacy derivation as the parity arm)."""
+    from heat3d_tpu import eqn
+
+    return eqn.solver_taps(cfg)
 
 
 def _pin_padding(

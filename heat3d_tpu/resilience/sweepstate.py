@@ -130,10 +130,15 @@ def row_key(cfg, bench: str = "throughput") -> str:
     env_bits = ",".join(
         f"{k}={os.environ[k]}" for k in ROUTE_ENV_KNOBS if k in os.environ
     )
+    # equation leg only when non-heat (same legacy-journal-compatible
+    # suffix rule as :hp / halo_order): every pre-eqn journal key stays
+    # byte-identical, and a spec-built family's stage can never collide
+    # with the heat stage of the same shape
+    eq = "" if cfg.equation == "heat" else f":eq{cfg.equation}"
     return (
         f"{bench}:g{g}:m{m}:{cfg.stencil.kind}:{cfg.precision.storage}"
         f":c{cfg.precision.compute}:b{cfg.backend}:tb{cfg.time_blocking}"
-        f":ov{int(cfg.overlap)}:h{cfg.halo}{ho}{hp}"
+        f":ov{int(cfg.overlap)}:h{cfg.halo}{ho}{hp}{eq}"
         + (f":env[{env_bits}]" if env_bits else "")
     )
 

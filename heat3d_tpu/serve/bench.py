@@ -80,6 +80,9 @@ def bench_ensemble_throughput(
         "platform": jax.default_backend(),
         "grid": list(cfg.grid.shape),
         "stencil": cfg.stencil.kind,
+        # equation-family provenance, same contract as the solo harness
+        # rows (check_provenance requires it; regress keys on it)
+        "equation": cfg.equation,
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "compute_dtype": cfg.precision.compute,

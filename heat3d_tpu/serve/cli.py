@@ -71,7 +71,21 @@ def _base_from_record(rec: dict):
         backend="jnp",
         halo="ppermute",
         time_blocking=int(rec.get("time_blocking", 1)),
+        # equation family is STRUCTURAL (it shapes the compiled chain, so
+        # it buckets); the member-level eq_params overrides below stay
+        # runtime inputs of the shared program (docs/SERVING.md)
+        equation=rec.get("equation", "heat"),
     )
+
+
+def _eq_pairs(rec: dict) -> tuple:
+    ep = rec.get("eq_params") or {}
+    if not isinstance(ep, dict):
+        raise ValueError(
+            f"request eq_params must be an object of name -> value, got "
+            f"{ep!r}"
+        )
+    return tuple(sorted((str(k), float(v)) for k, v in ep.items()))
 
 
 def _scenario_from_record(rec: dict):
@@ -84,6 +98,7 @@ def _scenario_from_record(rec: dict):
         bc_value=float(rec.get("bc_value", 0.0)),
         steps=rec.get("steps"),
         seed=int(rec.get("seed", 0)),
+        eq_params=_eq_pairs(rec),
     )
 
 

@@ -40,6 +40,15 @@ class Scenario:
     bc_value: float = 0.0
     steps: Optional[int] = None
     seed: int = 0
+    # Per-member equation-parameter overrides ((name, value) pairs —
+    # e.g. a member's own advection velocity) on top of the BASE config's
+    # equation family + eq_params. The traced bind feeds the member's
+    # lowered tap values into the shared parametric chain, so per-member
+    # spec coefficients ride with zero recompilation (docs/SERVING.md
+    # "Per-member spec binding"); the footprint guard still applies —
+    # values that change which taps are nonzero fail loudly at batch
+    # construction.
+    eq_params: Tuple[Tuple[str, float], ...] = ()
 
     def __post_init__(self):
         if self.alpha <= 0.0:
@@ -51,6 +60,12 @@ class Scenario:
             raise ValueError(f"scenario dt must be > 0, got {self.dt}")
         if self.steps is not None and self.steps < 0:
             raise ValueError(f"scenario steps must be >= 0, got {self.steps}")
+        if not isinstance(self.eq_params, tuple):
+            object.__setattr__(
+                self,
+                "eq_params",
+                tuple((str(k), float(v)) for k, v in self.eq_params),
+            )
 
 
 class ScenarioBatch:
@@ -90,8 +105,11 @@ class ScenarioBatch:
         """The full solo :class:`SolverConfig` member ``i`` describes —
         what a single-tenant :class:`HeatSolver3D` run of this scenario
         would be configured with (the bitwise reference the ensemble
-        equivalence tests compare against)."""
+        equivalence tests compare against). A member's ``eq_params``
+        overlay the base's (member pairs win on name clashes)."""
         m = self.members[i]
+        eq = dict(self.base.eq_params)
+        eq.update(dict(m.eq_params))
         return dataclasses.replace(
             self.base,
             grid=dataclasses.replace(
@@ -103,6 +121,7 @@ class ScenarioBatch:
             run=dataclasses.replace(
                 self.base.run, num_steps=self.member_steps(i), seed=m.seed
             ),
+            eq_params=tuple(sorted(eq.items())),
         )
 
     def member_steps(self, i: int) -> int:
@@ -110,14 +129,15 @@ class ScenarioBatch:
         return self.base.run.num_steps if m.steps is None else m.steps
 
     def member_taps(self, i: int) -> np.ndarray:
-        from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+        """Member ``i``'s lowered update taps, via the equation frontend
+        on the member's solo config — for the heat family this is
+        bit-identical to the old inline ``stencil_taps(kind, alpha, dt,
+        spacing)`` call (the eqn bitwise contract), and for spec-built
+        families it carries the member's own equation coefficients into
+        the traced bind."""
+        from heat3d_tpu import eqn
 
-        return stencil_taps(
-            STENCILS[self.base.stencil.kind],
-            self.members[i].alpha,
-            self.member_dt(i),
-            self.base.grid.spacing,
-        )
+        return eqn.solver_taps(self.member_config(i))
 
     def _check_footprints(self) -> None:
         from heat3d_tpu.core.stencils import flat_taps
@@ -155,6 +175,13 @@ def solver_bucket_key(cfg: SolverConfig) -> Tuple:
         tuple(cfg.grid.shape),
         tuple(cfg.grid.spacing),
         cfg.stencil.kind,
+        # equation family + base params shape the compiled chain (its
+        # footprint and term structure) — requests of different families
+        # must never pack into one program. Member-level eq_params stay
+        # runtime inputs (the traced bind), so they deliberately do NOT
+        # bucket.
+        cfg.equation,
+        tuple(cfg.eq_params),
         cfg.stencil.bc.value,
         tuple(cfg.mesh.shape),
         cfg.precision.storage,

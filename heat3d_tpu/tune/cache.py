@@ -5,7 +5,7 @@ Persistent/partitioned stencil-communication work (PAPERS.md) shows the
 winning transport/overlap choice is topology- and size-dependent — so the
 cache key is exactly that context:
 
-    <chip generation>|p<processes>|d<devices>|g2^<bucket>|<stencil>|<dtype>
+    <chip generation>|p<processes>|d<devices>|g2^<bucket>|<equation fingerprint>|<dtype>
 
 - **chip generation**: ``jax.devices()[0].device_kind`` normalized
   (``tpu-v5-lite`` / ``cpu`` / ...) — a v5e winner must not steer a v5p.
@@ -14,7 +14,10 @@ cache key is exactly that context:
 - **g2^bucket**: round(log2(grid cells per device)) — configs of similar
   per-chip working set share a winner; a 1024^3 entry must not steer a
   32^3 smoke run.
-- **stencil/dtype**: the compute shape and HBM traffic class.
+- **equation fingerprint/dtype**: the compute shape and HBM traffic
+  class. The fingerprint (``eqn.fingerprint``) is the bare stencil kind
+  for heat (committed entries stay addressable) and
+  ``family:kind:spec-hash`` for spec-built families (docs/EQUATIONS.md).
 
 Entry schema (``lint`` checks it; ``schema`` guards forward drift)::
 
@@ -123,19 +126,28 @@ def cache_key(cfg: SolverConfig, batch_size: int = 1) -> str:
     winner measured for one solo run must not steer a 64-member packed
     batch (whose per-chip working set and halo:compute ratio differ), and
     vice versa. Solo keys stay byte-identical to the pre-batch format so
-    every committed cache entry remains addressable."""
+    every committed cache entry remains addressable.
+
+    The stencil leg is the EQUATION FINGERPRINT (``eqn.fingerprint``):
+    the bare stencil kind for the heat family — byte-identical to every
+    committed pre-eqn key — and ``<family>:<kind>:<spec hash>`` for
+    spec-built families, so an advection winner can never steer a heat
+    run of the same footprint (their chain structure and stability
+    envelope differ)."""
     try:
         import jax
 
         procs = int(jax.process_count())
     except Exception:  # noqa: BLE001
         procs = 1
+    from heat3d_tpu import eqn
+
     parts = [
         chip_generation(),
         f"p{procs}",
         f"d{cfg.mesh.num_devices}",
         f"g2^{_grid_bucket(cfg)}",
-        cfg.stencil.kind,
+        eqn.fingerprint(cfg),
         cfg.precision.storage,
     ]
     if batch_size > 1:
@@ -144,7 +156,14 @@ def cache_key(cfg: SolverConfig, batch_size: int = 1) -> str:
 
 
 def config_knobs(cfg: SolverConfig) -> Dict[str, Any]:
-    """The judged knob values of ``cfg`` as a plain dict (entry payload)."""
+    """The judged knob values of ``cfg`` as a plain dict (entry payload).
+
+    ``equation``/``eq_params`` are workload CONTEXT, not searched knobs
+    (the key's fingerprint leg buckets on them) — persisted so ``tune
+    apply`` can reconstruct the measured workload's exact flag line
+    (the eq_params values feed the fingerprint hash; re-deriving them
+    from apply-time flags would silently address a different bucket).
+    Resolution never applies them (they are not in CONFIG_KNOBS)."""
     return {
         "backend": cfg.backend,
         "halo": cfg.halo,
@@ -153,6 +172,8 @@ def config_knobs(cfg: SolverConfig) -> Dict[str, Any]:
         "halo_order": cfg.halo_order,
         "halo_plan": cfg.halo_plan,
         "mesh": list(cfg.mesh.shape),
+        "equation": cfg.equation,
+        "eq_params": [[k, v] for k, v in cfg.eq_params],
     }
 
 

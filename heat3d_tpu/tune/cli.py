@@ -61,6 +61,8 @@ def _base_config(args):
         prec = dataclasses.replace(
             prec, compute="bfloat16" if cd == "bf16" else "float32"
         )
+    from heat3d_tpu.eqn.cli import parse_eq_params
+
     return SolverConfig(
         grid=GridConfig(shape=grid),
         stencil=StencilConfig(kind=args.stencil),
@@ -74,6 +76,10 @@ def _base_config(args):
         time_blocking=1,
         halo_order="axis",
         halo_plan="monolithic",
+        # equation context: keys the search/apply at the family's own
+        # cache bucket (eqn.fingerprint leg — docs/EQUATIONS.md)
+        equation=getattr(args, "equation", "heat"),
+        eq_params=parse_eq_params(getattr(args, "eq_param", [])),
     )
 
 
@@ -222,6 +228,13 @@ def _entry_lines(key: str, e: dict) -> str:
         # whole-face collectives here — more, smaller messages, transport
         # overlapped with the remaining compute (docs/TUNING.md)
         speed += "; partitioned-exchange winner (early-bird sub-block sends)"
+    fam = cfg.get("equation") or _key_equation(key)
+    if fam != "heat":
+        # spec-built-family winners (entry field, or the key's
+        # family:kind:spec-hash fingerprint leg for hand-edited stores —
+        # docs/EQUATIONS.md): say the family so an operator reading the
+        # table doesn't mistake it for heat
+        speed += f"; equation={fam}"
     return (
         f"{key}\n"
         f"    config: {_fmt_knobs(cfg)}\n"
@@ -229,6 +242,17 @@ def _entry_lines(key: str, e: dict) -> str:
         f"    measured: {prov.get('ts')} jax={prov.get('jax_version')} "
         f"run={prov.get('run_id')}"
     )
+
+
+def _key_equation(key: str) -> str:
+    """The equation family a cache key's fingerprint leg names — 'heat'
+    for bare stencil-kind legs (every pre-eqn committed key), else the
+    family half of ``family:kind:spec-hash`` (eqn.fingerprint)."""
+    parts = key.split("|")
+    if len(parts) < 6:
+        return "heat"
+    leg = parts[4]
+    return leg.split(":", 1)[0] if ":" in leg else "heat"
 
 
 def cmd_show(args) -> int:
@@ -287,6 +311,18 @@ def cmd_apply(args) -> int:
         parts.append("--overlap")
     if cfg.get("mesh"):
         parts += ["--mesh"] + [str(x) for x in cfg["mesh"]]
+    # equation context: the ENTRY persists the measured workload's
+    # family + exact eq_params (config_knobs), so the flag line
+    # reconstructs the very bucket the winner was measured for — values
+    # emitted at full repr precision (the fingerprint hashes them; a
+    # rounded value would silently address a different bucket). Entries
+    # predating the eqn subsystem carry no field and are heat; the key's
+    # fingerprint leg is the fallback for the family name.
+    fam = cfg.get("equation") or _key_equation(key)
+    if fam != "heat":
+        parts += ["--equation", str(fam)]
+        for name, value in cfg.get("eq_params") or []:
+            parts += ["--eq-param", f"{name}={value!r}"]
     print(" ".join(parts))
     return 0
 
@@ -333,6 +369,13 @@ def _add_context_args(p) -> None:
     p.add_argument("--grid", type=int, nargs="+", default=[32],
                    help="global grid: one int (cube) or three")
     p.add_argument("--stencil", choices=["7pt", "27pt"], default="7pt")
+    p.add_argument("--equation", default="heat",
+                   help="equation family context (heat3d eqn list): keys "
+                   "the search/apply at the family's own cache bucket")
+    p.add_argument("--eq-param", action="append", default=[],
+                   metavar="NAME=VALUE",
+                   help="equation parameter override (repeatable) — part "
+                   "of the cache-key fingerprint for non-heat families")
     p.add_argument("--dtype", choices=["fp32", "bf16"], default="fp32")
     p.add_argument("--compute-dtype", choices=["fp32", "bf16"], default=None,
                    help="stencil-math dtype override (default: the "

@@ -332,3 +332,16 @@ if [[ -z "${SKIP_SERVE_SMOKE:-}" ]]; then
 else
   note "suite: serve smoke skipped (SKIP_SERVE_SMOKE=1)"
 fi
+
+# Equation-frontend smoke (informational, beside the serve smoke): one
+# spec-built family end-to-end through the solver CLI with the fp64
+# golden check — the declarative eqn subsystem (docs/EQUATIONS.md) can't
+# rot between equation sessions. Sub-minute on CPU. Fails SOFT;
+# SKIP_EQN_SMOKE=1 skips.
+if [[ -z "${SKIP_EQN_SMOKE:-}" ]]; then
+  python -m heat3d_tpu.cli --grid 24 --steps 5 \
+    --equation advection-diffusion --golden-check >> "$SUITE_LOG" 2>&1 \
+    || note "suite: eqn smoke failed (rc=$?) — informational"
+else
+  note "suite: eqn smoke skipped (SKIP_EQN_SMOKE=1)"
+fi

@@ -1173,7 +1173,234 @@ def check_plan_ensemble_parity():
     print("plan_ensemble_parity OK")
 
 
+def check_eqn_heat_spec_vs_legacy_bitwise():
+    """The declarative equation frontend reproduces the legacy hardcoded
+    heat path BITWISE on real multi-device meshes — the eqn tentpole
+    acceptance criterion. The default run compiles the heat spec
+    (eqn.solver_taps); the reference arm (HEAT3D_EQN_LEGACY=1) runs the
+    verbatim pre-spec stencil_taps derivation. Arms span 7pt/27pt x
+    tb{1,2} x axis/pairwise x monolithic/partitioned plans (the
+    partition floor zeroed so sub-block permutes genuinely issue)."""
+    import os
+
+    from heat3d_tpu.parallel import plan as hplan
+
+    grid = (16, 16, 16)
+    u_host = golden.random_init(grid, seed=41)
+    combos = [
+        ("7pt", 1, "axis", "monolithic", (4, 1, 1)),
+        ("7pt", 1, "pairwise", "monolithic", (2, 2, 1)),
+        ("7pt", 1, "axis", "partitioned", (2, 2, 1)),
+        ("7pt", 2, "axis", "monolithic", (2, 2, 1)),
+        ("7pt", 2, "axis", "partitioned", (4, 1, 1)),
+        ("27pt", 1, "axis", "monolithic", (2, 2, 1)),
+        ("27pt", 1, "axis", "partitioned", (4, 1, 1)),
+        ("27pt", 2, "axis", "monolithic", (4, 1, 1)),
+    ]
+    os.environ[hplan.ENV_PART_MIN_BYTES] = "0"
+    try:
+        for kind, tb, ho, hp, mesh_shape in combos:
+            cfg = SolverConfig(
+                grid=GridConfig(shape=grid),
+                stencil=StencilConfig(kind=kind, bc_value=0.5),
+                mesh=MeshConfig(shape=mesh_shape),
+                backend="jnp",
+                time_blocking=tb,
+                halo_order=ho,
+                halo_plan=hp,
+                equation="heat",
+            )
+            steps = max(3, tb + 1)
+            hplan.clear_plan_cache()
+            got = _run_solver(cfg, u_host, steps)
+            os.environ["HEAT3D_EQN_LEGACY"] = "1"
+            try:
+                want = _run_solver(cfg, u_host, steps)
+            finally:
+                del os.environ["HEAT3D_EQN_LEGACY"]
+            assert np.array_equal(got, want), (
+                f"spec-compiled heat != legacy hardcoded path bitwise "
+                f"({kind} tb={tb} {ho} {hp} mesh={mesh_shape})"
+            )
+    finally:
+        del os.environ[hplan.ENV_PART_MIN_BYTES]
+    print("eqn_heat_spec_vs_legacy_bitwise OK")
+
+
+def check_eqn_families_golden_distributed():
+    """Every spec-built family advances correctly end-to-end on a real
+    4-device mesh: the distributed fp32 run matches the fp64 golden
+    stepper driven with the SAME spec-compiled taps (machinery parity —
+    halo plans, supersteps, padding pins all carrying the new taps), and
+    the periodic plane-wave arm tracks the family's analytic MMS
+    solution. One arm runs the auto knobs (halo='auto',
+    time_blocking=0) so tuner resolution of an eqn config is exercised,
+    and one runs a partitioned plan."""
+    import dataclasses
+
+    from heat3d_tpu import eqn
+
+    grid = (16, 16, 16)
+    # (family, params, tb, plan, mesh, dt) — reaction combos pass an
+    # explicit dt: their decay rates tighten the explicit-Euler bound
+    # below the default diffusion-only derivation, which config
+    # validation now (correctly) rejects for non-heat families
+    combos = [
+        ("aniso-diffusion", (), 1, "monolithic", (2, 2, 1), None),
+        ("advection-diffusion", (("vx", 0.8), ("vy", 0.4)), 1,
+         "partitioned", (4, 1, 1), None),
+        ("advection-diffusion", (), 2, "monolithic", (2, 2, 1), None),
+        ("reaction-diffusion", (("rate", -0.7),), 1, "monolithic",
+         (4, 1, 1), 0.3),
+        ("reaction-diffusion", (), 2, "partitioned", (2, 2, 1), 0.3),
+    ]
+    import os
+
+    from heat3d_tpu.parallel import plan as hplan
+
+    os.environ[hplan.ENV_PART_MIN_BYTES] = "0"
+    try:
+        for fam, params, tb, hp, mesh_shape, dt in combos:
+            cfg = SolverConfig(
+                grid=GridConfig(shape=grid, alpha=0.4, dt=dt),
+                stencil=StencilConfig(kind="7pt", bc_value=0.25),
+                mesh=MeshConfig(shape=mesh_shape),
+                backend="jnp",
+                time_blocking=tb,
+                halo_plan=hp,
+                equation=fam,
+                eq_params=params,
+            )
+            hplan.clear_plan_cache()
+            u_host = golden.random_init(grid, seed=43)
+            steps = 6
+            got = _run_solver(cfg, u_host, steps).astype(np.float64)
+            want = golden.run(
+                u_host, cfg.grid, cfg.stencil, steps,
+                taps=eqn.solver_taps(cfg),
+            )
+            rel = np.max(np.abs(got - want)) / max(np.max(np.abs(want)), 1e-30)
+            assert rel < 1e-5, (
+                f"{fam} tb={tb} {hp} mesh={mesh_shape}: distributed run "
+                f"diverges from the fp64 golden oracle (rel {rel:.2e})"
+            )
+    finally:
+        del os.environ[hplan.ENV_PART_MIN_BYTES]
+
+    # tuner-resolution arm: auto knobs on an eqn config resolve through
+    # the cache (miss -> static fallback) and the run still matches gold
+    cfg = SolverConfig(
+        grid=GridConfig(shape=grid, alpha=0.4),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(2, 2, 1)),
+        backend="jnp",
+        halo="auto",
+        time_blocking=0,
+        equation="advection-diffusion",
+    )
+    u_host = golden.random_init(grid, seed=44)
+    got = _run_solver(cfg, u_host, 5).astype(np.float64)
+    resolved = dataclasses.replace(cfg, halo="ppermute", time_blocking=1)
+    want = golden.run(
+        u_host, resolved.grid, resolved.stencil, 5,
+        taps=eqn.solver_taps(resolved),
+    )
+    rel = np.max(np.abs(got - want)) / max(np.max(np.abs(want)), 1e-30)
+    assert rel < 1e-5, f"auto-knob eqn run diverges from gold (rel {rel:.2e})"
+
+    # MMS arm: periodic plane wave vs the analytic solution (loose bound
+    # — the convergence-order discipline lives in tests/test_eqn.py; this
+    # proves the DISTRIBUTED program tracks the same continuous solution)
+    n = 16
+    shape = (n, n, n)
+    spacing = (1.0 / n, 1.0 / n, 1.0 / n)
+    cfg = SolverConfig(
+        grid=GridConfig(shape=shape, spacing=spacing, alpha=0.01,
+                        dt=2e-4),
+        stencil=StencilConfig(kind="7pt", bc=BoundaryCondition.PERIODIC),
+        mesh=MeshConfig(shape=(2, 2, 1)),
+        backend="jnp",
+        equation="advection-diffusion",
+        eq_params=(("vx", 0.5), ("vy", 0.25), ("vz", 0.0)),
+    )
+    wave = (1, 1, 0)
+    steps = 50
+    t_end = steps * cfg.grid.effective_dt()
+    mu, omega = eqn.mms_rates(cfg, golden.wavevector(shape, spacing, wave))
+    u0 = golden.plane_wave(shape, spacing, wave)
+    got = _run_solver(cfg, u0.astype(np.float32), steps).astype(np.float64)
+    want = golden.plane_wave(shape, spacing, wave, t=t_end, mu=mu,
+                             omega=omega)
+    err = np.max(np.abs(got - want))
+    assert err < 5e-2, (
+        f"distributed advection-diffusion run does not track the "
+        f"analytic plane wave (max err {err:.3e})"
+    )
+    print("eqn_families_golden_distributed OK")
+
+
+def check_eqn_serve_traced_bind():
+    """Per-member spec coefficients through the serve traced bind: an
+    advection-diffusion batch whose members carry DIFFERENT velocities
+    (Scenario.eq_params) runs as ONE compiled parametric program on the
+    hybrid b=2 x (2,1,1) mesh, and each member matches its own solo
+    HeatSolver3D run; the baked certification mode is bitwise-identical
+    to the solo runs by construction."""
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+    from heat3d_tpu.serve.ensemble import EnsembleSolver
+    from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+
+    base = SolverConfig(
+        grid=GridConfig.cube(16, alpha=0.4),
+        mesh=MeshConfig(shape=(2, 1, 1)),
+        backend="jnp",
+        equation="advection-diffusion",
+    )
+    members = [
+        Scenario(alpha=0.4, steps=5, eq_params=(("vx", 0.5),)),
+        Scenario(alpha=0.3, steps=5, eq_params=(("vx", 1.0), ("vy", 0.5))),
+    ]
+    batch = ScenarioBatch(base, members)
+
+    solos = []
+    for i in range(len(members)):
+        cfg_i = batch.member_config(i)
+        s = HeatSolver3D(cfg_i)
+        solos.append(s.gather(s.run(s.init_state("hot-cube"), 5)))
+
+    es = EnsembleSolver(batch, batch_mesh=2, bind="traced")
+    fields = es.gather(es.run(es.init_state(), None))
+    for i, solo in enumerate(solos):
+        rel = np.max(np.abs(fields[i].astype(np.float64) - solo)) / max(
+            float(np.max(np.abs(solo))), 1e-30
+        )
+        assert rel < 1e-5, (
+            f"traced-bind member {i} (own velocity) diverges from its "
+            f"solo run (rel {rel:.2e})"
+        )
+
+    es_baked = EnsembleSolver(batch, batch_mesh=1, bind="baked")
+    fields_b = es_baked.gather(es_baked.run(es_baked.init_state(), None))
+    for i, solo in enumerate(solos):
+        assert np.array_equal(fields_b[i], solo.astype(fields_b.dtype)), (
+            f"baked member {i} != its solo run bitwise"
+        )
+    print("eqn_serve_traced_bind OK")
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "eqn":
+        # focused tier-1 entry (tests/test_eqn.py runs it unmarked on a
+        # 4-device mesh): the declarative-equation acceptance battery —
+        # spec-vs-legacy heat bitwise, family golden/MMS e2e, serve
+        # traced-bind with per-member spec coefficients
+        n = len(jax.devices())
+        assert n >= 4, f"expected >= 4 CPU devices, got {n}"
+        check_eqn_heat_spec_vs_legacy_bitwise()
+        check_eqn_families_golden_distributed()
+        check_eqn_serve_traced_bind()
+        print("ALL MULTIDEVICE CHECKS PASSED")
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "plan":
         # focused tier-1 entry (tests/test_plan.py runs it unmarked on a
         # 4-device mesh): the persistent-exchange-plan acceptance battery

@@ -621,6 +621,9 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
         # ensemble-workload provenance (PR 7): required on every
         # throughput row — solo rows carry [1]/1
         "batch_shape": [1], "members_per_step": 1,
+        # equation-family provenance (PR 11): required on every
+        # throughput row — legacy rows key to heat downstream
+        "equation": "heat",
     }
     halo_good = {
         "bench": "halo", "ts": "2026-01-01T00:00:00Z", "platform": "tpu",
