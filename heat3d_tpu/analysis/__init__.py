@@ -4,16 +4,21 @@ Six AST/arithmetic checkers over the repo's own source (docs/ANALYSIS.md
 is the catalog), one shared finding/severity/suppression framework
 (:mod:`~heat3d_tpu.analysis.findings`), the promoted data-lint cores
 behind ``scripts/check_ledger.py`` / ``scripts/check_provenance.py``,
-and the IR tier (:mod:`~heat3d_tpu.analysis.ir`, ``heat3d lint --ir``)
-that traces the judged config matrix and certifies the closed jaxprs.
-``heat3d lint`` (:mod:`~heat3d_tpu.analysis.cli`) is the operator/CI
-entry point: rc 1 only on unsuppressed error-severity findings.
+the IR tier (:mod:`~heat3d_tpu.analysis.ir`, ``heat3d lint --ir``)
+that traces the judged config matrix and certifies the closed jaxprs,
+and the kernel tier (:mod:`~heat3d_tpu.analysis.kernel`,
+``heat3d lint --kernel``) that traces every Pallas kernel body and
+certifies the in-kernel DMA/ring schedules the interpret-tier parity
+tests cannot see. ``heat3d lint`` (:mod:`~heat3d_tpu.analysis.cli`) is
+the operator/CI entry point (``--all`` = every tier, one merged
+verdict): rc 1 only on unsuppressed error-severity findings.
 
 The source checkers parse, they do not import, the code they audit —
 except where the arithmetic itself is the artifact under audit (VMEM
 budget estimators, the live knob surfaces), which is loaded
 deliberately. The IR tier goes one step further and audits the
-*programs* the code builds, not the code.
+*programs* the code builds; the kernel tier goes inside the one opaque
+box the IR tier left — ``pallas_call`` bodies.
 """
 
 from __future__ import annotations

@@ -9,6 +9,8 @@ Usage::
     heat3d lint --list                   # checker catalog
     heat3d lint --ir [--json]            # IR-tier program verifier
     heat3d lint --ir --checker ir-dtype  # one IR family
+    heat3d lint --kernel [--json]        # kernel-tier Pallas verifier
+    heat3d lint --all [--json]           # every tier, one merged verdict
 
 ``--ir`` switches to the IR-tier catalog (:mod:`heat3d_tpu.analysis.ir`):
 instead of parsing source, it traces the judged config matrix through
@@ -17,6 +19,18 @@ jaxprs (collective topology, halo footprint, dtype flow, compiled
 memory contract). Same severity/suppression/baseline machinery; IR
 findings fingerprint on (checker, config-key, invariant), so baselines
 survive jaxpr pretty-printer drift across jax versions.
+
+``--kernel`` switches to the kernel-tier catalog
+(:mod:`heat3d_tpu.analysis.kernel`): every repo Pallas kernel body is
+traced to its jaxpr and a concrete interpreter replays the full grid,
+certifying DMA start/wait discipline, ring-slot happens-before, output
+coverage and remote-copy neighbor targets — the schedules the
+interpret-tier value-parity tests cannot see. Fingerprints anchor on
+(checker, kernel-case key, invariant), same stability contract.
+
+``--all`` runs the AST, IR and kernel tiers in ONE process and merges
+everything into a single verdict (one JSON document, one rc) — the
+pre-merge sweep ``scripts/lint_all.sh`` uses.
 
 Severity policy (docs/ANALYSIS.md): rc 1 **only** on unsuppressed
 error-severity findings — warnings are drift that needs a decision, info
@@ -111,6 +125,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "checkers",
     )
     p.add_argument(
+        "--kernel", action="store_true",
+        help="run the kernel-tier Pallas verifier (trace every repo "
+        "kernel body and certify DMA discipline, ring races, output "
+        "coverage and remote targets) instead of the source checkers",
+    )
+    p.add_argument(
+        "--all", action="store_true",
+        help="run every tier (AST + IR + kernel) in one process with a "
+        "single merged verdict and rc",
+    )
+    p.add_argument(
         "--checker", action="append", default=[],
         help="run only this checker (repeatable, or comma-separated)",
     )
@@ -138,8 +163,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = p.parse_args(argv)
 
+    if sum(map(bool, (args.ir, args.kernel, args.all))) > 1:
+        raise SystemExit(
+            "heat3d lint: --ir, --kernel and --all are mutually exclusive"
+        )
     if args.ir:
         from heat3d_tpu.analysis.ir import IR_CHECKERS as catalog
+    elif args.kernel:
+        from heat3d_tpu.analysis.kernel import KERNEL_CHECKERS as catalog
+    elif args.all:
+        from heat3d_tpu.analysis.ir import IR_CHECKERS
+        from heat3d_tpu.analysis.kernel import KERNEL_CHECKERS
+
+        catalog = {**CHECKERS, **IR_CHECKERS, **KERNEL_CHECKERS}
     else:
         catalog = CHECKERS
 
@@ -157,6 +193,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         from heat3d_tpu.analysis.ir import run_ir_checkers
 
         findings = run_ir_checkers(root, names)
+    elif args.kernel:
+        from heat3d_tpu.analysis.kernel import run_kernel_checkers
+
+        findings = run_kernel_checkers(root, names)
+    elif args.all:
+        from heat3d_tpu.analysis.hostdev import ensure_host_devices
+        from heat3d_tpu.analysis.ir import run_ir_checkers
+        from heat3d_tpu.analysis.ir import programs as ir_programs
+        from heat3d_tpu.analysis.kernel import run_kernel_checkers
+        from heat3d_tpu.analysis.kernel import programs as kernel_programs
+
+        # one process, three tiers, one merged verdict: AST first (no
+        # jax), then the device-posture-sensitive tiers. ONE posture is
+        # resolved up front — the max of every tier's wanted count — so
+        # whichever tier initializes jax first cannot silently degrade
+        # the other's configured matrix (e.g. HEAT3D_IR_DEVICES=8 with
+        # the kernel tier's default 4)
+        ast_names = [n for n in names if n in CHECKERS]
+        ir_names = [n for n in names if n in IR_CHECKERS]
+        kernel_names = [n for n in names if n in KERNEL_CHECKERS]
+        if ir_names or kernel_names:
+            ensure_host_devices(
+                max(
+                    ir_programs.wanted_devices(),
+                    kernel_programs.wanted_devices(),
+                )
+            )
+        findings = list(run_checkers(root, ast_names))
+        if kernel_names:
+            findings.extend(run_kernel_checkers(root, kernel_names))
+        if ir_names:
+            findings.extend(run_ir_checkers(root, ir_names))
     else:
         findings = run_checkers(root, names)
     baseline = load_baseline(baseline_path)

@@ -1,0 +1,37 @@
+"""Shared CPU host-device forcing for the program-tracing lint tiers.
+
+Both the IR tier (``HEAT3D_IR_DEVICES``) and the kernel tier
+(``HEAT3D_KERNEL_LINT_DEVICES``) want a multi-device CPU backend for
+their judged meshes/rings, and both can only get one BEFORE jax
+initializes. This is the single implementation of that dance (it leans
+on a private jax API — ``xla_bridge.backends_are_initialized`` — which
+must not be duplicated per tier), and the place ``lint --all`` resolves
+ONE posture for the whole process: the max of every tier's wanted
+count, so one tier's default cannot silently degrade another's
+configured matrix.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_host_devices(want: int) -> int:
+    """Force ``want`` CPU host devices when jax is still uninitialized
+    (no-op otherwise — callers surface a degraded posture themselves);
+    returns the visible device count either way."""
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # noqa: BLE001 - private API; assume the worst
+        initialized = True
+    if not initialized and want > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}"
+            ).strip()
+    return len(jax.devices())

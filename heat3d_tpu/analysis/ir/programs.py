@@ -41,23 +41,12 @@ def wanted_devices() -> int:
 
 def ensure_devices() -> int:
     """Force a multi-device CPU backend for the judged meshes when still
-    possible; returns the visible device count either way."""
-    import jax
+    possible; returns the visible device count either way. (Shared
+    implementation: analysis/hostdev.py — `lint --all` resolves the
+    max posture across tiers through the same helper.)"""
+    from heat3d_tpu.analysis.hostdev import ensure_host_devices
 
-    want = wanted_devices()
-    try:
-        from jax._src import xla_bridge
-
-        initialized = xla_bridge.backends_are_initialized()
-    except Exception:  # noqa: BLE001 - private API; assume the worst
-        initialized = True
-    if not initialized and want > 1:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={want}"
-            ).strip()
-    return len(jax.devices())
+    return ensure_host_devices(wanted_devices())
 
 
 def compile_enabled() -> bool:

@@ -1,0 +1,159 @@
+"""kernel-coverage (ANL1021-1023) — every output element written exactly
+once across the grid.
+
+A Pallas output block is flushed to HBM when the grid moves off its
+block index; whatever the VMEM tile holds at that moment is the result.
+Three ways that silently corrupts (all invisible to interpret-mode
+parity at the shapes where they happen *not* to corrupt, and none
+visible in the jnp reference):
+
+- **ANL1021** — an output block no grid step ever maps to: its HBM
+  region is never flushed (stale/garbage output).
+- **ANL1022** — a block revisited after the pipeline left it: the block
+  index sequence is non-contiguous, so the block is fetched/flushed
+  twice and the second run's initial tile content is pipeline-dependent.
+- **ANL1023** — a visit run in which the kernel never writes the block:
+  the flush emits whatever the tile held (the "parked" index trick —
+  e.g. the streaming kernels park on block 0 during ring priming — is
+  only sound because the park run ends with a real write; this checker
+  is what holds that).
+
+Index maps are abstract-interpreted exactly: each output's
+``index_map_jaxpr`` is evaluated at every grid point in row-major
+pipeline order, runs are segmented, and writes come from the simulated
+effect timeline (completed DMA landings count — the exchange kernels'
+ghost outputs are written by the remote copy, committed at the recv
+wait).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Set, Tuple
+
+from heat3d_tpu.analysis.findings import ERROR, Finding
+from heat3d_tpu.analysis.kernel import interp
+
+CHECKER = "kernel-coverage"
+
+
+def _finding(case, code, invariant, message) -> Finding:
+    return Finding(
+        checker=CHECKER,
+        severity=ERROR,
+        path=case.path,
+        line=0,
+        code=code,
+        symbol=f"{case.key}|{invariant}",
+        message=f"[{case.key}] {case.entry}: {message}",
+    )
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _runs(visits):
+    """Segment a visit sequence into per-block contiguous runs:
+    ``block -> [[step, ...], ...]``."""
+    runs: Dict[Tuple[int, ...], List[List[Tuple[int, ...]]]] = {}
+    prev = None
+    for step, block in visits:
+        if block != prev:
+            runs.setdefault(block, []).append([])
+            prev = block
+        runs[block][-1].append(step)
+    return runs
+
+
+def _write_steps(case, ci, out_ref_idx) -> Set[Tuple[int, ...]]:
+    """Grid steps at which ANY simulated device position produces a
+    committed write (kernel store or completed DMA landing) to the
+    output ref. Union over positions: a write predicated on a device
+    coordinate (a Dirichlet edge fill) still counts as covering the
+    step; a step NO position writes is a genuine hole."""
+    from heat3d_tpu.analysis.kernel.races import replay
+
+    steps: Set[Tuple[int, ...]] = set()
+    for rec in case.sims(ci):
+        writes, _ = replay(rec)
+        for (ref, _plane), log in writes.items():
+            if ref == out_ref_idx:
+                steps.update(t for t, _o in log)
+    return steps
+
+
+def check_case(case) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: set = set()
+
+    def emit(code, invariant, message):
+        key = (code, invariant)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(_finding(case, code, invariant, message))
+
+    for ci, eqn in enumerate(case.calls()):
+        gm = eqn.params["grid_mapping"]
+        n_idx = getattr(gm, "num_index_operands", 0)
+        for oi, bm, visits in interp.out_block_visits(eqn):
+            ref_idx = n_idx + gm.num_inputs + oi
+            writes = _write_steps(case, ci, ref_idx)
+            shape = tuple(bm.array_shape_dtype.shape)
+            block = tuple(bm.block_shape) if bm.block_shape else ()
+            if not visits:
+                continue
+            runs = _runs(visits)
+            if gm.grid and block and len(block) == len(shape):
+                want = list(
+                    itertools.product(
+                        *[range(_ceil_div(s, b)) for s, b in zip(shape, block)]
+                    )
+                )
+            else:  # whole-ref output (no windowed mapping)
+                want = [visits[0][1]]
+            for b in want:
+                if b not in runs:
+                    emit(
+                        "ANL1021",
+                        f"call{ci}|out{oi}|uncovered|{b}",
+                        f"call #{ci} output #{oi}: block {b} of "
+                        f"{_ceil_div(shape[0], block[0]) if block else 1} "
+                        "x ... is never visited by the grid — its HBM "
+                        "region is never written",
+                    )
+            for b, rs in runs.items():
+                if len(rs) > 1:
+                    emit(
+                        "ANL1022",
+                        f"call{ci}|out{oi}|revisit|{b}",
+                        f"call #{ci} output #{oi}: block {b} is visited "
+                        f"in {len(rs)} separate runs (first two end/"
+                        f"begin at grid{rs[0][-1]} / grid{rs[1][0]}) — "
+                        "the pipeline flushes it twice and the second "
+                        "run's initial tile content is undefined",
+                    )
+                for run in rs[:1] if len(rs) > 1 else rs:
+                    if not any(step in writes for step in run):
+                        emit(
+                            "ANL1023",
+                            f"call{ci}|out{oi}|unwritten-run|{b}",
+                            f"call #{ci} output #{oi}: the grid visits "
+                            f"block {b} over steps grid{run[0]}.."
+                            f"grid{run[-1]} but no device position "
+                            "writes it during that run — the flush "
+                            "emits stale VMEM tile content",
+                        )
+    return findings
+
+
+def check(root: str, cases=None) -> List[Finding]:
+    from heat3d_tpu.analysis.kernel import programs
+
+    if cases is None:
+        cases = programs.judged_kernels()
+    findings: List[Finding] = []
+    for case in cases:
+        findings.extend(check_case(case))
+    return findings

@@ -1,0 +1,185 @@
+"""kernel-dma (ANL1001-1005) — in-kernel DMA start/wait discipline.
+
+The invariant the interpret tier cannot see: interpret mode discharges
+``dma_start`` synchronously, so a copy that is started and never waited,
+a wait with no matching start, or two in-flight copies aliasing one
+semaphore cell all *execute correctly* there — and deadlock or corrupt
+silently on hardware, where the semaphore counts are real. This checker
+replays every judged kernel's full grid at every device position
+(:mod:`..interp`) and audits the semaphore ledger exactly:
+
+- **ANL1001** — a started copy's send or recv semaphore cell is still
+  armed when the grid ends: the start has no matching wait on the
+  control path this device/grid actually takes.
+- **ANL1002** — a ``dma_wait`` on a semaphore cell with no copy in
+  flight: the wait blocks forever on hardware (or consumes a stray
+  signal and desynchronizes the next exchange).
+- **ANL1003** — a ``dma_start`` arms a semaphore cell that is already
+  armed by a still-in-flight copy: two transfers share one completion
+  count, so a single wait can retire the wrong copy.
+- **ANL1004** — barrier-semaphore imbalance: the neighbor signals a
+  device issues (SPMD-mirrored: every peer runs the same program, so my
+  expected arrivals equal the incs I send) do not cover its waits.
+- **ANL1005** (warning) — the simulator could not resolve part of the
+  kernel's control flow, so the discipline is NOT certified; an
+  unanalyzable kernel must never read as clean.
+
+Remote-copy accounting is SPMD-mirrored: my ``dma_start`` into a
+neighbor's ghost ref arms my *own* recv cell, because the symmetric peer
+program starts the copy that lands in mine — the same reasoning the
+kernels' comments pin ("my recv_sem[0] = lo nb's push into lo_ref").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from heat3d_tpu.analysis.findings import ERROR, WARNING, Finding
+
+CHECKER = "kernel-dma"
+
+
+def _cell_name(cell: Tuple[int, object]) -> str:
+    idx, plane = cell
+    base = "barrier" if idx < 0 else f"sem{idx}"
+    return base if plane is None else f"{base}[{plane}]"
+
+
+def _finding(case, severity, code, invariant, message) -> Finding:
+    return Finding(
+        checker=CHECKER,
+        severity=severity,
+        path=case.path,
+        line=0,
+        code=code,
+        symbol=f"{case.key}|{invariant}",
+        message=f"[{case.key}] {case.entry}: {message}",
+    )
+
+
+def check_case(case) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: set = set()
+
+    def emit(severity, code, invariant, message):
+        key = (code, invariant)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(_finding(case, severity, code, invariant, message))
+
+    for ci in range(len(case.calls())):
+        for rec in case.sims(ci):
+            if rec.incomplete:
+                emit(
+                    WARNING,
+                    "ANL1005",
+                    f"call{ci}|unanalyzable",
+                    f"call #{ci}: control flow not fully analyzable "
+                    f"({'; '.join(rec.incomplete)}) — DMA discipline NOT "
+                    "certified for this kernel",
+                )
+            armed: Dict[Tuple[int, object], object] = {}
+            barrier_balance = 0
+            saw_barrier = False
+            for ev in rec.events:
+                if ev.kind == "dma_start":
+                    for side, cell in (
+                        ("send", ev.info.get("send_cell")),
+                        ("recv", ev.info.get("recv_cell")),
+                    ):
+                        if cell is None:
+                            continue
+                        if cell in armed:
+                            emit(
+                                ERROR,
+                                "ANL1003",
+                                f"call{ci}|alias|{_cell_name(cell)}",
+                                f"call #{ci} at grid{ev.time} "
+                                f"(device {rec.ctx or 'solo'}): dma_start "
+                                f"arms {side} semaphore "
+                                f"{_cell_name(cell)} while a copy started "
+                                f"at grid{armed[cell]} is still in flight "
+                                "— two transfers share one completion "
+                                "count",
+                            )
+                        armed[cell] = ev.time
+                elif ev.kind == "dma_wait":
+                    cell = ev.info.get("recv_cell")
+                    if cell in armed:
+                        del armed[cell]
+                    else:
+                        emit(
+                            ERROR,
+                            "ANL1002",
+                            f"call{ci}|wait-without-start|{_cell_name(cell)}",
+                            f"call #{ci} at grid{ev.time} "
+                            f"(device {rec.ctx or 'solo'}): dma_wait on "
+                            f"{_cell_name(cell)} with no copy in flight — "
+                            "blocks forever on hardware",
+                        )
+                elif ev.kind == "sem_signal" and ev.ref < 0:
+                    saw_barrier = True
+                    inc = ev.info.get("inc")
+                    if not isinstance(inc, int):
+                        # a data-dependent increment is "not certified",
+                        # not a checker crash
+                        emit(
+                            WARNING,
+                            "ANL1005",
+                            f"call{ci}|opaque-barrier",
+                            f"call #{ci}: barrier signal increment is not "
+                            "concretely evaluable — barrier discipline "
+                            "NOT certified for this kernel",
+                        )
+                        continue
+                    # SPMD mirror: a signal sent to any neighbor arrives
+                    # at my own barrier cell from the symmetric peer
+                    barrier_balance += inc
+                elif ev.kind == "sem_wait" and ev.ref < 0:
+                    saw_barrier = True
+                    value = ev.info.get("value")
+                    if not isinstance(value, int):
+                        emit(
+                            WARNING,
+                            "ANL1005",
+                            f"call{ci}|opaque-barrier",
+                            f"call #{ci}: barrier wait value is not "
+                            "concretely evaluable — barrier discipline "
+                            "NOT certified for this kernel",
+                        )
+                        continue
+                    barrier_balance -= value
+            for cell, started in armed.items():
+                emit(
+                    ERROR,
+                    "ANL1001",
+                    f"call{ci}|start-without-wait|{_cell_name(cell)}",
+                    f"call #{ci}: copy started at grid{started} "
+                    f"(device {rec.ctx or 'solo'}) on "
+                    f"{_cell_name(cell)} is never waited on this control "
+                    "path — the semaphore stays armed into the next "
+                    "kernel invocation",
+                )
+            if saw_barrier and barrier_balance != 0:
+                emit(
+                    ERROR,
+                    "ANL1004",
+                    f"call{ci}|barrier-imbalance",
+                    f"call #{ci} (device {rec.ctx or 'solo'}): barrier "
+                    f"semaphore signals and waits do not balance "
+                    f"(residue {barrier_balance:+d} under the SPMD "
+                    "mirror) — a desynchronized neighbor barrier",
+                )
+    return findings
+
+
+def check(root: str, cases=None) -> List[Finding]:
+    from heat3d_tpu.analysis.kernel import programs
+
+    if cases is None:
+        cases = programs.judged_kernels()
+    findings: List[Finding] = []
+    for case in cases:
+        findings.extend(check_case(case))
+    return findings

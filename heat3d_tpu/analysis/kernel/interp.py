@@ -1,0 +1,523 @@
+"""Concrete kernel-body interpreter — the engine under every kernel-tier
+checker.
+
+A Pallas kernel body is a jaxpr over ``Ref``s whose *scheduling* skeleton
+(``pl.when`` predicates, ring-slot arithmetic, DMA starts/waits, semaphore
+choreography) is a pure function of ``program_id`` and ``lax.axis_index``
+— both concrete once a grid step and a device position are fixed. This
+module walks that skeleton exactly: it iterates the full grid in the
+row-major order the Mosaic pipeline executes (``dimension_semantics`` is
+unset/arbitrary on every repo kernel), evaluates every scalar expression
+concretely, resolves every ``cond`` branch, and records the effect
+stream — ``Ref`` reads/writes with their plane indices, DMA
+starts/waits with their semaphore cells and device targets, semaphore
+signals/waits — as a timeline of :class:`Event` records the checkers
+turn into happens-before verdicts.
+
+Vector *values* are deliberately opaque (class :data:`OPAQUE`): the
+interpret-tier parity tests already prove values; this tier proves
+*schedules*, which is exactly what those tests cannot see (interpret
+mode discharges DMA synchronously, so an unwaited copy or an in-flight
+read still produces correct values there).
+
+If a predicate ever fails to resolve concretely (none does today — the
+repo kernels branch only on ``program_id``/``axis_index`` arithmetic),
+the simulation records the spot in ``ExecRecord.incomplete`` instead of
+guessing, and the DMA-discipline checker surfaces it as a warning: an
+unanalyzable kernel must read as "not certified", never as clean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.core as jcore
+from jax import tree_util
+
+
+class _Opaque:
+    """Marker for values the scalar interpreter does not track."""
+
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return "OPAQUE"
+
+
+OPAQUE = _Opaque()
+
+
+@dataclasses.dataclass(frozen=True)
+class RefToken:
+    """Identity of a kernel ``Ref`` operand: its position in the kernel
+    jaxpr's invars (stable across retraces of the same source)."""
+
+    idx: int
+
+
+# the synthetic ref index get_barrier_semaphore yields (kernel invars are
+# nonnegative positions; the barrier ref is not an operand)
+BARRIER_REF = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class RefInfo:
+    idx: int
+    role: str  # "in" | "out" | "scratch" | "sem"
+    shape: Tuple[int, ...]
+    space: str  # memory-space string: "vmem" | "any" | "semaphore_mem" | ...
+    sem_kind: Optional[str] = None  # dma_sem | barrier_sem | sem
+
+
+@dataclasses.dataclass
+class Event:
+    """One effect at one grid step of one simulated device.
+
+    ``time`` is the grid index tuple, ``order`` a global program-order
+    counter (happens-before within and across steps), ``pt`` the static
+    program point (eqn-index path through the cond tree — stable across
+    retraces), and ``branch`` the enclosing branch path (``pt[:-1]``),
+    which groups the reads of one firing stage."""
+
+    kind: str  # read | write | dma_start | dma_wait | sem_signal | sem_wait
+    ref: int
+    plane: Any  # int plane index | ("s", start, size) | None (whole/unknown)
+    time: Tuple[int, ...]
+    order: int
+    pt: Tuple[int, ...]
+    info: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def branch(self) -> Tuple[int, ...]:
+        return self.pt[:-1]
+
+
+@dataclasses.dataclass
+class ExecRecord:
+    """One full-grid simulation of one kernel on one device position."""
+
+    ctx: Dict[str, Tuple[int, int]]  # axis name -> (index, size)
+    grid: Tuple[int, ...]
+    refs: List[RefInfo]
+    events: List[Event]
+    incomplete: List[str]  # reasons analysis is partial (empty = complete)
+
+
+_SEM_DTYPES = ("dma_sem", "barrier_sem", "sem")
+
+
+def classify_refs(call_eqn) -> List[RefInfo]:
+    """Roles of the kernel jaxpr invars from the grid mapping: scalar
+    index operands (none in this repo), then inputs, outputs, scratch
+    (semaphores included)."""
+    gm = call_eqn.params["grid_mapping"]
+    jaxpr = call_eqn.params["jaxpr"]
+    n_idx = getattr(gm, "num_index_operands", 0)
+    n_in = gm.num_inputs
+    n_out = gm.num_outputs
+    infos: List[RefInfo] = []
+    for i, v in enumerate(jaxpr.invars):
+        aval = v.aval
+        inner = getattr(aval, "inner_aval", aval)
+        dt = str(getattr(inner, "dtype", ""))
+        shape = tuple(getattr(inner, "shape", ()))
+        space = str(getattr(aval, "memory_space", "") or "")
+        if i < n_idx:
+            role = "in"
+        elif i < n_idx + n_in:
+            role = "in"
+        elif i < n_idx + n_in + n_out:
+            role = "out"
+        else:
+            role = "sem" if dt in _SEM_DTYPES else "scratch"
+        infos.append(
+            RefInfo(
+                idx=i,
+                role=role,
+                shape=shape,
+                space=space,
+                sem_kind=dt if dt in _SEM_DTYPES else None,
+            )
+        )
+    return infos
+
+
+def _py(x):
+    """Concrete python scalar from a numpy/jax 0-d value, else OPAQUE."""
+    if isinstance(x, (bool, int, float)):
+        return x
+    if isinstance(x, _Opaque) or x is None:
+        return x
+    try:
+        if getattr(x, "shape", None) == () or getattr(x, "ndim", None) == 0:
+            return x.item()
+    except Exception:  # noqa: BLE001 - anything weird stays opaque
+        return OPAQUE
+    return OPAQUE
+
+
+def _trunc_rem(a, b):
+    # lax.rem is C-style (truncated) remainder, not python's floor mod
+    q = int(a / b) if b else 0
+    return a - b * q
+
+
+def _trunc_div(a, b):
+    # lax.div on integers truncates toward zero, not python's floor
+    if isinstance(a, int) and isinstance(b, int):
+        return int(a / b) if b else 0
+    return a / b
+
+
+_BINOPS = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "max": max,
+    "min": min,
+    "rem": _trunc_rem,
+    "div": _trunc_div,
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+    "and": lambda a, b: bool(a) and bool(b),
+    "or": lambda a, b: bool(a) or bool(b),
+    "xor": lambda a, b: bool(a) ^ bool(b),
+}
+
+_UNOPS = {
+    "not": lambda a: not a,
+    "neg": lambda a: -a,
+    "sign": lambda a: (a > 0) - (a < 0),
+    "abs": abs,
+    "floor": int,
+    "ceil": lambda a: -int(-a),
+}
+
+
+def _decode_plane(transforms) -> Any:
+    """Normalize an indexer-transform chain to a plane id on the leading
+    dim: an int for a scalar index, ("s", start, size) for a leading
+    slice, None for whole-ref / undecodable access."""
+    if not transforms:
+        return None
+    t = transforms[0]
+    indices = getattr(t, "indices", None)
+    if indices is None or not len(indices):
+        return None
+    lead = indices[0]
+    lead = _py(lead) if not hasattr(lead, "start") else lead
+    if isinstance(lead, (int, bool)):
+        return int(lead)
+    if hasattr(lead, "start"):
+        start = _py(lead.start)
+        size = _py(lead.size)
+        if isinstance(start, int) and isinstance(size, int):
+            return ("s", start, size)
+        return None
+    return None
+
+
+class _KernelSim:
+    def __init__(self, call_eqn, ctx: Dict[str, Tuple[int, int]]):
+        self.eqn = call_eqn
+        self.gm = call_eqn.params["grid_mapping"]
+        self.jaxpr = call_eqn.params["jaxpr"]
+        self.ctx = ctx
+        self.refs = classify_refs(call_eqn)
+        self.events: List[Event] = []
+        self.incomplete: List[str] = []
+        self.order = 0
+        self.step: Tuple[int, ...] = ()
+
+    # -- value resolution ---------------------------------------------------
+
+    def _val(self, env, v):
+        if isinstance(v, jcore.Literal):
+            return _py(v.val)
+        return env.get(v, OPAQUE)
+
+    def _emit(self, kind, ref, plane, pt, **info):
+        self.order += 1
+        self.events.append(
+            Event(
+                kind=kind,
+                ref=ref,
+                plane=plane,
+                time=self.step,
+                order=self.order,
+                pt=pt,
+                info=info,
+            )
+        )
+
+    def _ref_idx(self, tok) -> Optional[int]:
+        if isinstance(tok, RefToken):
+            return tok.idx
+        return None
+
+    # -- effect primitives --------------------------------------------------
+
+    def _sem_cell(self, ref_tok, transforms):
+        idx = self._ref_idx(ref_tok)
+        plane = _decode_plane(transforms or ())
+        return (idx, plane if isinstance(plane, int) else None)
+
+    def _handle_get(self, eqn, env, pt):
+        ref = self._val(env, eqn.invars[0])
+        leaves = [self._val(env, v) for v in eqn.invars[1:]]
+        transforms = tree_util.tree_unflatten(eqn.params["tree"], leaves)
+        ridx = self._ref_idx(ref)
+        if ridx is not None:
+            self._emit("read", ridx, _decode_plane(transforms), pt)
+        for ov in eqn.outvars:
+            env[ov] = OPAQUE
+
+    def _handle_swap(self, eqn, env, pt):
+        ref = self._val(env, eqn.invars[0])
+        leaves = [self._val(env, v) for v in eqn.invars[2:]]
+        transforms = tree_util.tree_unflatten(eqn.params["tree"], leaves)
+        ridx = self._ref_idx(ref)
+        if ridx is not None:
+            self._emit("write", ridx, _decode_plane(transforms), pt)
+        for ov in eqn.outvars:
+            env[ov] = OPAQUE
+
+    def _handle_dma_start(self, eqn, env, pt, *, wait: bool):
+        leaves = [self._val(env, v) for v in eqn.invars]
+        (
+            src,
+            src_t,
+            dst,
+            dst_t,
+            dst_sem,
+            dst_sem_t,
+            src_sem,
+            src_sem_t,
+            device_id,
+        ) = tree_util.tree_unflatten(eqn.params["tree"], leaves)
+        src_i = self._ref_idx(src)
+        dst_i = self._ref_idx(dst)
+        recv_cell = self._sem_cell(dst_sem, dst_sem_t)
+        send_cell = (
+            self._sem_cell(src_sem, src_sem_t) if src_sem is not None else None
+        )
+        if isinstance(device_id, dict):
+            device_id = {k: _py(v) for k, v in device_id.items()}
+        else:
+            device_id = _py(device_id)
+        kind = "dma_wait" if wait else "dma_start"
+        self._emit(
+            kind,
+            dst_i if dst_i is not None else -2,
+            _decode_plane(dst_t),
+            pt,
+            src=src_i,
+            src_plane=_decode_plane(src_t),
+            recv_cell=recv_cell,
+            send_cell=send_cell,
+            device_id=device_id,
+            remote=src_sem is not None,
+        )
+
+    def _handle_sem(self, eqn, env, pt, name):
+        leaves = [self._val(env, v) for v in eqn.invars]
+        parts = tree_util.tree_unflatten(eqn.params["args_tree"], leaves)
+        sem, sem_t = parts[0], parts[1]
+        cell = self._sem_cell(sem, sem_t)
+        if name == "semaphore_signal":
+            inc = _py(parts[2]) if len(parts) > 2 else 1
+            device_id = parts[3] if len(parts) > 3 else None
+            if isinstance(device_id, dict):
+                device_id = {k: _py(v) for k, v in device_id.items()}
+            else:
+                device_id = _py(device_id) if device_id is not None else None
+            self._emit(
+                "sem_signal", cell[0], cell[1], pt, cell=cell, inc=inc,
+                device_id=device_id,
+            )
+        else:
+            value = _py(parts[2]) if len(parts) > 2 else 1
+            self._emit("sem_wait", cell[0], cell[1], pt, cell=cell, value=value)
+
+    # -- the walk ----------------------------------------------------------
+
+    def _eval_jaxpr(self, jaxpr, env, pt_prefix):
+        for ei, eqn in enumerate(jaxpr.eqns):
+            pt = pt_prefix + (ei,)
+            name = eqn.primitive.name
+            if name == "cond":
+                pred = self._val(env, eqn.invars[0])
+                if isinstance(pred, _Opaque):
+                    spot = f"opaque cond predicate at pt={pt}"
+                    if spot not in self.incomplete:
+                        self.incomplete.append(spot)
+                    for ov in eqn.outvars:
+                        env[ov] = OPAQUE
+                    continue
+                branches = eqn.params["branches"]
+                bi = min(max(int(pred), 0), len(branches) - 1)
+                closed = branches[bi]
+                benv = {}
+                for cv, c in zip(closed.jaxpr.constvars, closed.consts):
+                    benv[cv] = _py(c)
+                for bv, opnd in zip(closed.jaxpr.invars, eqn.invars[1:]):
+                    benv[bv] = self._val(env, opnd)
+                self._eval_jaxpr(closed.jaxpr, benv, pt + (bi,))
+                for ov, bo in zip(eqn.outvars, closed.jaxpr.outvars):
+                    env[ov] = self._val(benv, bo)
+                continue
+            if name == "get":
+                self._handle_get(eqn, env, pt)
+                continue
+            if name == "swap":
+                self._handle_swap(eqn, env, pt)
+                continue
+            if name == "dma_start":
+                self._handle_dma_start(eqn, env, pt, wait=False)
+                continue
+            if name == "dma_wait":
+                self._handle_dma_start(eqn, env, pt, wait=True)
+                continue
+            if name in ("semaphore_signal", "semaphore_wait"):
+                self._handle_sem(eqn, env, pt, name)
+                continue
+            if name == "get_barrier_semaphore":
+                env[eqn.outvars[0]] = RefToken(BARRIER_REF)
+                continue
+            if name == "program_id":
+                env[eqn.outvars[0]] = int(self.step[eqn.params["axis"]])
+                continue
+            if name == "num_programs":
+                env[eqn.outvars[0]] = int(self.gm.grid[eqn.params["axis"]])
+                continue
+            if name == "axis_index":
+                ax = eqn.params["axis_name"]
+                if isinstance(ax, (tuple, list)):
+                    ax = ax[0] if len(ax) == 1 else ax
+                pos = self.ctx.get(ax)
+                env[eqn.outvars[0]] = pos[0] if pos else OPAQUE
+                continue
+            if name in ("convert_element_type", "copy", "stop_gradient"):
+                v = self._val(env, eqn.invars[0])
+                if isinstance(v, bool) and "int" in str(
+                    eqn.params.get("new_dtype", "")
+                ):
+                    v = int(v)
+                env[eqn.outvars[0]] = v
+                continue
+            if name == "select_n":
+                which = self._val(env, eqn.invars[0])
+                if isinstance(which, (bool, int)):
+                    env[eqn.outvars[0]] = self._val(
+                        env, eqn.invars[1 + int(which)]
+                    )
+                else:
+                    env[eqn.outvars[0]] = OPAQUE
+                continue
+            if name == "clamp":
+                lo, x, hi = (self._val(env, v) for v in eqn.invars)
+                if all(isinstance(v, (int, float, bool)) for v in (lo, x, hi)):
+                    env[eqn.outvars[0]] = min(max(x, lo), hi)
+                else:
+                    env[eqn.outvars[0]] = OPAQUE
+                continue
+            if name in _BINOPS and len(eqn.invars) == 2:
+                a = self._val(env, eqn.invars[0])
+                b = self._val(env, eqn.invars[1])
+                if isinstance(a, (bool, int, float)) and isinstance(
+                    b, (bool, int, float)
+                ):
+                    env[eqn.outvars[0]] = _BINOPS[name](a, b)
+                else:
+                    env[eqn.outvars[0]] = OPAQUE
+                continue
+            if name in _UNOPS and len(eqn.invars) == 1:
+                a = self._val(env, eqn.invars[0])
+                if isinstance(a, (bool, int, float)):
+                    env[eqn.outvars[0]] = _UNOPS[name](a)
+                else:
+                    env[eqn.outvars[0]] = OPAQUE
+                continue
+            sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            if (
+                isinstance(sub, jcore.ClosedJaxpr)
+                and name not in ("scan", "while")
+                and len(sub.jaxpr.invars) == len(eqn.invars)
+            ):
+                # inline call-like eqns (pjit from jnp.where etc.): the
+                # scheduling scalars often route through them
+                benv = {}
+                for cv, c in zip(sub.jaxpr.constvars, sub.consts):
+                    benv[cv] = _py(c)
+                for bv, opnd in zip(sub.jaxpr.invars, eqn.invars):
+                    benv[bv] = self._val(env, opnd)
+                self._eval_jaxpr(sub.jaxpr, benv, pt + (0,))
+                for ov, bo in zip(eqn.outvars, sub.jaxpr.outvars):
+                    env[ov] = self._val(benv, bo)
+                continue
+            if name in ("scan", "while"):
+                spot = (
+                    f"{name} loop at pt={pt} — kernel control flow the "
+                    "simulator does not model"
+                )
+                if spot not in self.incomplete:
+                    self.incomplete.append(spot)
+            # vector compute and anything else: opaque outputs
+            for ov in eqn.outvars:
+                env[ov] = OPAQUE
+
+    def run(self) -> ExecRecord:
+        grid = tuple(int(g) for g in self.gm.grid)
+        steps = itertools.product(*[range(g) for g in grid]) if grid else [()]
+        base_env = {}
+        for i, v in enumerate(self.jaxpr.invars):
+            base_env[v] = RefToken(i)
+        for cv in getattr(self.jaxpr, "constvars", ()):
+            base_env[cv] = OPAQUE
+        for step in steps:
+            self.step = tuple(step)
+            self._eval_jaxpr(self.jaxpr, dict(base_env), ())
+        return ExecRecord(
+            ctx=dict(self.ctx),
+            grid=grid,
+            refs=self.refs,
+            events=self.events,
+            incomplete=list(self.incomplete),
+        )
+
+
+def simulate(call_eqn, ctx: Dict[str, Tuple[int, int]]) -> ExecRecord:
+    """Run one kernel ``pallas_call`` eqn over its full grid at one device
+    position; returns the effect timeline."""
+    return _KernelSim(call_eqn, ctx).run()
+
+
+def out_block_visits(call_eqn):
+    """Per-output block-index visit sequences, in row-major grid order:
+    ``[(out_index, [(step, block_tuple), ...]), ...]`` — the grid/output
+    coverage checker's raw material. Outputs without a windowed block
+    mapping (whole-ref VMEM/ANY outputs) yield block ``()`` every step."""
+    gm = call_eqn.params["grid_mapping"]
+    grid = tuple(int(g) for g in gm.grid)
+    steps = (
+        list(itertools.product(*[range(g) for g in grid])) if grid else [()]
+    )
+    out = []
+    for oi in range(gm.num_outputs):
+        bm = gm.block_mappings[gm.num_inputs + oi]
+        cj = bm.index_map_jaxpr
+        visits = []
+        for step in steps:
+            if grid:
+                idx = jcore.eval_jaxpr(cj.jaxpr, cj.consts, *step)
+                visits.append((tuple(step), tuple(int(i) for i in idx)))
+            else:
+                visits.append(((), ()))
+        out.append((oi, bm, visits))
+    return out

@@ -144,6 +144,17 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
     "ir_lint_verdict": {"kind": "point", "module": "analysis/ir/__init__.py",
                         "desc": "IR verifier verdict: per-severity "
                                 "finding counts per family set"},
+    # kernel lint (heat3d lint --kernel)
+    "kernel_lint_start": {"kind": "point",
+                          "module": "analysis/kernel/__init__.py",
+                          "desc": "kernel verifier opened: families, "
+                                  "judged-kernel case count, device "
+                                  "posture"},
+    "kernel_lint_verdict": {"kind": "point",
+                            "module": "analysis/kernel/__init__.py",
+                            "desc": "kernel verifier verdict: per-"
+                                    "severity finding counts per family "
+                                    "set"},
     # serving (batched scenario engine)
     "serve_submit": {"kind": "point", "module": "serve/queue.py",
                      "desc": "scenario request enqueued (request_id, depth)"},
@@ -271,6 +282,11 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
     "HEAT3D_IR_COMPILE": {"module": "analysis/ir/programs.py",
                           "desc": "0 skips the compiled memory-contract "
                                   "leg of heat3d lint --ir"},
+    "HEAT3D_KERNEL_LINT_DEVICES": {"module": "analysis/kernel/programs.py",
+                                   "desc": "host-device count the kernel "
+                                           "lint forces for its judged "
+                                           "rings (default 4; only "
+                                           "before jax initializes)"},
     "HEAT3D_SLO_SPEC": {"module": "obs/perf/slo.py",
                         "desc": "SLO objective-spec path (obs slo / "
                                 "serve --slo default)"},

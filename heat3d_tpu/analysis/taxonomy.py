@@ -128,12 +128,14 @@ def check(
             )
             # the analysis package and registry NAME events/vars without
             # emitting them; scanning them would count every registry
-            # entry as emitted. The IR verifier subpackage is the
-            # exception: it genuinely emits ir_lint_* and reads
-            # HEAT3D_IR_* (it is production tooling, not a checker-of-
-            # names), so it stays in the scan.
+            # entry as emitted. The IR and kernel verifier subpackages
+            # are the exception: they genuinely emit ir_lint_* /
+            # kernel_lint_* and read HEAT3D_IR_* /
+            # HEAT3D_KERNEL_LINT_* (production tooling, not
+            # checkers-of-names), so they stay in the scan.
             if os.sep + "analysis" + os.sep not in p
             or os.sep + os.path.join("analysis", "ir") + os.sep in p
+            or os.sep + os.path.join("analysis", "kernel") + os.sep in p
         ]
         script_files = [
             os.path.join(root, "scripts", fn)

@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
+from heat3d_tpu.utils.compat import pallas_tpu_compiler_params
 
 
 def _exchange_body(
@@ -179,7 +180,7 @@ def _exchange_axis_dma_width1(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             has_side_effects=True,
             collective_id=axis,
         ),
@@ -288,7 +289,7 @@ def exchange_axis_dma(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             has_side_effects=True,
             collective_id=axis,
         ),

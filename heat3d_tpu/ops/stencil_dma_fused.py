@@ -50,6 +50,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from heat3d_tpu.core.stencils import effective_num_taps, flat_taps
+from heat3d_tpu.utils.compat import pallas_tpu_compiler_params
 from heat3d_tpu.ops.stencil_pallas import _plane_taps
 from heat3d_tpu.ops.stencil_pallas_direct import (
     _chunk_ghost_rows,
@@ -603,7 +604,7 @@ def apply_step_fused_dma(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             has_side_effects=True,
             collective_id=_COLLECTIVE_ID,
         ),
@@ -965,7 +966,7 @@ def apply_superstep_fused_dma(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             has_side_effects=True,
             collective_id=_COLLECTIVE_ID_TB2,
         ),

@@ -62,6 +62,42 @@ def axis_size(axis_name):
     return getattr(frame, "size", frame)
 
 
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` across pallas API generations.
+
+    jax 0.4.x ships the class as ``TPUCompilerParams`` and without the
+    ``has_side_effects`` field (side-effect tracking landed with the
+    rename); newer jaxes accept the full field set under the new name.
+    On the old API, unknown fields are dropped so the kernel modules
+    stay traceable off-TPU (the kernel-tier lint traces every Pallas
+    kernel body on CPU, and interpret-mode execution discharges DMA
+    synchronously — the annotation is meaningless there) — but a
+    requested ``has_side_effects=True`` on a REAL TPU backend raises
+    instead: silently compiling a side-effecting collective kernel
+    without the annotation would let XLA CSE/DCE/reorder it (the old
+    code's AttributeError was at least loud; this keeps it loud and
+    names the fix)."""
+    import dataclasses
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+        known = {f.name for f in dataclasses.fields(cls)}
+        dropped = {k: v for k, v in kwargs.items() if k not in known}
+        if dropped.get("has_side_effects") and jax.default_backend() == "tpu":
+            raise RuntimeError(
+                "this jax's pallas API (TPUCompilerParams) cannot express "
+                "has_side_effects, which the side-effecting DMA kernels "
+                "require on a real TPU backend — upgrade jax to a version "
+                "shipping pltpu.CompilerParams before running the DMA "
+                "routes on hardware"
+            )
+        kwargs = {k: v for k, v in kwargs.items() if k in known}
+    return cls(**kwargs)
+
+
 def make_abstract_mesh(shape, axis_names):
     """``jax.sharding.AbstractMesh`` across its two constructor signatures:
     ``AbstractMesh(axis_sizes, axis_names)`` (current) vs the 0.4.x

@@ -1,15 +1,16 @@
 #!/usr/bin/env bash
 # One-shot pre-merge lint sweep (docs/ANALYSIS.md):
 #
-#   1. `heat3d lint` — the five static checkers over the source tree
-#      (rc 1 only on unsuppressed error-severity findings);
-#   2. `heat3d lint --ir` — the IR-tier program verifier (traces the
-#      judged config matrix and certifies collective topology, halo
-#      footprint, dtype flow and the compiled memory contract at the
-#      jaxpr level; same rc policy);
-#   3. ledger data lint WITH the taxonomy audit over every *ledger*.jsonl
+#   1. `heat3d lint --all` — every static tier in ONE process with one
+#      merged verdict: the AST checkers over the source tree, the
+#      IR-tier program verifier (collective topology, halo footprint,
+#      dtype flow, compiled memory contract at the jaxpr level), and
+#      the kernel-tier Pallas verifier (DMA discipline, ring-slot
+#      races, output coverage, remote targets inside kernel bodies);
+#      rc 1 only on unsuppressed error-severity findings;
+#   2. ledger data lint WITH the taxonomy audit over every *ledger*.jsonl
 #      argument (event names checked against the canonical registry);
-#   4. provenance lint over every other .jsonl argument (bench rows).
+#   3. provenance lint over every other .jsonl argument (bench rows).
 #
 # Usage: scripts/lint_all.sh [artifact.jsonl ...]
 #   scripts/lint_all.sh                                   # static only
@@ -26,11 +27,8 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== static analysis (heat3d lint) =="
-python -m heat3d_tpu.cli lint || rc=1
-
-echo "== IR certification (heat3d lint --ir) =="
-python -m heat3d_tpu.cli lint --ir || rc=1
+echo "== static + IR + kernel certification (heat3d lint --all) =="
+python -m heat3d_tpu.cli lint --all || rc=1
 
 for artifact in "$@"; do
   case "$artifact" in

@@ -259,6 +259,18 @@ if [[ -z "${SKIP_IR_LINT:-}" ]]; then
 else
   note "suite: IR lint skipped (SKIP_IR_LINT=1)"
 fi
+# Kernel-tier certification gate (docs/ANALYSIS.md "Kernel tier"): trace
+# every repo Pallas kernel body in a fresh process (so the multi-device
+# CPU rings can be forced) and certify DMA start/wait discipline,
+# ring-slot happens-before, output coverage and remote-copy neighbor
+# targets — the schedules interpret-tier parity cannot see. Same rc
+# policy; its rc is the suite's rc. SKIP_KERNEL_LINT=1 is the escape
+# hatch.
+if [[ -z "${SKIP_KERNEL_LINT:-}" ]]; then
+  python -m heat3d_tpu.cli lint --kernel --json | tee -a "$SUITE_LOG"
+else
+  note "suite: kernel lint skipped (SKIP_KERNEL_LINT=1)"
+fi
 python -m heat3d_tpu.obs.cli regress "$OUT" --start-line "$LINT_FROM" \
   --json | tee -a "$SUITE_LOG"
 
