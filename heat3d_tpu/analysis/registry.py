@@ -165,10 +165,39 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                     "desc": "one packed batch's execution bracket"},
     "serve_result": {"kind": "point", "module": "serve/queue.py",
                      "desc": "one request delivered (queue latency)"},
-    "serve_metrics_summary": {"kind": "point", "module": "serve/queue.py",
+    "serve_metrics_summary": {"kind": "point",
+                              "module": "serve/queue.py, serve/engine/",
                               "desc": "drain-final per-bucket latency "
                                       "p50/p95/max + depth high-water "
                                       "mark (the SLO layer's source)"},
+    # async serving engine (serve/engine/) + AOT cache (serve/aot.py)
+    "serve_dispatch": {"kind": "point", "module": "serve/engine/core.py",
+                       "desc": "dispatcher handed a packed chunk to a "
+                               "bucket worker (request ids, in-flight "
+                               "count at dispatch)"},
+    "serve_batch_ready": {"kind": "point", "module": "serve/engine/core.py",
+                          "desc": "a batch's device futures resolved in "
+                                  "its worker (execute seconds; the "
+                                  "dispatch->ready gap is the overlap "
+                                  "window)"},
+    "aot_cache_hit": {"kind": "point", "module": "serve/aot.py",
+                      "desc": "serialized executables loaded — no trace, "
+                              "no compile (measured load_s)"},
+    "aot_cache_miss": {"kind": "point", "module": "serve/aot.py",
+                       "desc": "no AOT store entry for this bucket key — "
+                               "compiling fresh"},
+    "aot_cache_stale": {"kind": "point", "module": "serve/aot.py",
+                        "desc": "store entry unusable (jax/platform/"
+                                "device drift, torn payload — reason "
+                                "field); recompile fallback"},
+    "aot_export": {"kind": "point", "module": "serve/aot.py",
+                   "desc": "compiled executables serialized into the AOT "
+                           "store (key, programs, bytes)"},
+    "compile_stall": {"kind": "point", "module": "serve/aot.py",
+                      "desc": "trace+compile stall actually paid for a "
+                              "serving bucket (measured seconds; absent "
+                              "on a warm AOT hit — the cold-start "
+                              "acceptance signal)"},
 }
 
 # Wrapper functions whose first argument is an event name (the taxonomy
@@ -275,6 +304,14 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
     "HEAT3D_SERVE_MAX_BATCH": {"module": "serve/queue.py",
                                "desc": "members per packed batch cap "
                                        "(default 64)"},
+    "HEAT3D_SERVE_WORKERS": {"module": "serve/engine/core.py",
+                             "desc": "async engine concurrent batch-"
+                                     "execution slots (default 2)"},
+    "HEAT3D_AOT_CACHE": {"module": "serve/aot.py",
+                         "desc": "AOT executable-store directory "
+                                 "(default ~/.cache/heat3d/aot; 0/off "
+                                 "disables persistence — stalls still "
+                                 "measured)"},
     "HEAT3D_IR_DEVICES": {"module": "analysis/ir/programs.py",
                           "desc": "host-device count the IR lint forces "
                                   "for the judged meshes (default 4; "

@@ -11,8 +11,26 @@ Layer 2 — :mod:`heat3d_tpu.serve.queue` / ``heat3d serve``: a request
 queue that packs compatible scenario submissions into shape-bucketed
 batches, executes them through cached compiled ensembles, and streams
 per-member results back with ledger spans and queue metrics.
+
+Layer 3 — :mod:`heat3d_tpu.serve.engine` / :mod:`heat3d_tpu.serve.aot`
+/ ``heat3d serve --async``: the always-on posture — a continuously-
+batching dispatcher/worker engine that accepts submissions while
+batches are in flight, backed by an AOT executable cache that
+eliminates the fresh-process trace+compile stall (docs/SERVING.md
+"Async engine & cold start").
 """
 
 from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch  # noqa: F401
 from heat3d_tpu.serve.ensemble import EnsembleSolver  # noqa: F401
 from heat3d_tpu.serve.queue import ScenarioQueue  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: the engine pulls in threading machinery and serve/aot pulls
+    # jax serialization — neither belongs on the import path of a caller
+    # that only wants Scenario/ScenarioBatch
+    if name == "AsyncServeEngine":
+        from heat3d_tpu.serve.engine import AsyncServeEngine
+
+        return AsyncServeEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,330 @@
+"""AOT executable cache — cold-start elimination for the serving tier.
+
+The serving engine's first request in a fresh process used to pay the
+full trace + XLA-compile stall before a single member advanced (hundreds
+of ms on CPU smoke shapes, tens of seconds for pod-scale ensembles).
+This module makes that stall a *managed artifact*: the bucketed ensemble
+executables are compiled ahead of time, serialized with
+``jax.experimental.serialize_executable`` (the PJRT executable itself,
+not a re-traceable staging of it — loading skips BOTH trace and
+compile), and stored under a key that carries everything that could make
+a stored program wrong to reuse:
+
+- the **structural bucket** (:func:`~heat3d_tpu.serve.scenario
+  .solver_bucket_key` + padded batch size + batch-mesh factorization) —
+  what shapes the program;
+- the **tune-cache key** (:func:`~heat3d_tpu.tune.cache.cache_key` at
+  the batch bucket) — chip generation, process/device counts, per-device
+  working-set bucket, equation fingerprint, dtype: the same context that
+  decides which knobs win decides which executable is valid;
+- **toolchain provenance** — jax version, platform, device kind/count.
+  A serialized executable is a build artifact of one exact stack;
+  anything else deserializes to undefined behavior, so a mismatch is
+  ``stale`` and falls back to a fresh compile, never an error.
+
+Ledger contract (docs/OBSERVABILITY.md §6): every warm-up lands exactly
+one of ``aot_cache_hit`` (with the measured ``load_s``) /
+``aot_cache_miss`` / ``aot_cache_stale`` (with the reason), a paid
+trace+compile lands a ``compile_stall`` event with its measured seconds
+(absent on a hit — the acceptance criterion a warm restart is judged
+by), and a store write lands ``aot_export``. Stall time is a measured
+ledger quantity either way, never an invisible first-request tax.
+
+``HEAT3D_AOT_CACHE`` points the store somewhere else (default
+``~/.cache/heat3d/aot``); ``0``/``off`` disables it — the engine then
+AOT-compiles at bucket creation (the stall is still measured and paid
+OUTSIDE the first request's latency) but persists nothing. Store IO
+fails soft: an unwritable directory or a torn payload degrades to
+compile-and-serve, never to a dead bucket.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+from heat3d_tpu import obs
+from heat3d_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_AOT = "HEAT3D_AOT_CACHE"
+AOT_SCHEMA = 1
+
+
+def aot_dir(explicit: Optional[str] = None) -> Optional[str]:
+    """The store directory: explicit arg > ``$HEAT3D_AOT_CACHE`` > the
+    per-user default. ``None`` when disabled (env set to ``0``/``off``)."""
+    if explicit:
+        return explicit
+    env = os.environ.get(ENV_AOT)
+    if env is not None:
+        if env.strip().lower() in ("", "0", "off"):
+            return None
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "heat3d", "aot")
+
+
+def _toolchain() -> Dict[str, Any]:
+    """The provenance legs a serialized executable is only valid under.
+    Device kind + count pin the exact SPMD layout the payload was
+    compiled for (a 4-device program must not load into a 2-device
+    session)."""
+    prov: Dict[str, Any] = {"schema": AOT_SCHEMA}
+    try:
+        import jax
+
+        prov["jax_version"] = jax.__version__
+        prov["platform"] = jax.default_backend()
+        devs = jax.devices()
+        prov["devices"] = len(devs)
+        prov["device_kind"] = getattr(devs[0], "device_kind", devs[0].platform)
+    except Exception:  # noqa: BLE001 - provenance derivation fails soft
+        prov.update(
+            jax_version=None, platform=None, devices=0, device_kind=None
+        )
+    return prov
+
+
+def aot_key(solver) -> str:
+    """The content key of ``solver``'s compiled programs: a hash over the
+    structural bucket, the batch factorization, the tune-cache key at the
+    batch bucket (chip/topology/working-set/equation/dtype context), and
+    every resolved leg that shapes the TRACED program beyond the bucket:
+    mehrstellen decomposability, time_blocking after auto-resolution,
+    the EFFECTIVE exchange-plan mode + partition floor (halo_plan is not
+    in ``solver_bucket_key`` but changes the ppermute schedule — a tuned
+    partitioned winner must never warm-hit a monolithic executable), and
+    the chain-factoring env gates (``_chain_accumulate`` emits under
+    them)."""
+    from heat3d_tpu.parallel.plan import effective_halo_plan
+    from heat3d_tpu.serve.scenario import solver_bucket_key
+    from heat3d_tpu.tune import cache as tcache
+
+    tc = _toolchain()
+    doc = {
+        "bucket": [list(x) if isinstance(x, tuple) else x
+                   for x in solver_bucket_key(solver.cfg)],
+        "B": solver.B,
+        "batch_mesh": solver.batch_mesh,
+        "bind": solver.bind,
+        "tune_key": tcache.cache_key(solver.cfg, batch_size=solver.B),
+        "mehrstellen": bool(solver._mehrstellen),
+        "time_blocking": solver.cfg.time_blocking,
+        # the exchange schedule legs: effective mode folds HEAT3D_NO_PLAN
+        # in (parallel.plan's one rule); the floor changes which faces
+        # genuinely sub-block under partitioned
+        "halo_plan": effective_halo_plan(solver.cfg),
+        "plan_floor": os.environ.get("HEAT3D_PLAN_PART_MIN_BYTES"),
+        # chain-emission structure gates (docs/LOWERING.md factoring A/Bs)
+        "factor_env": [
+            os.environ.get("HEAT3D_FACTOR_7PT"),
+            os.environ.get("HEAT3D_FACTOR_Y"),
+        ],
+        "jax": tc["jax_version"],
+        "platform": tc["platform"],
+        "devices": tc["devices"],
+        "device_kind": tc["device_kind"],
+        "schema": AOT_SCHEMA,
+    }
+    blob = json.dumps(doc, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
+
+
+def _manifest_path(d: str, key: str) -> str:
+    return os.path.join(d, f"{key}.json")
+
+
+def _payload_path(d: str, key: str, name: str) -> str:
+    return os.path.join(d, f"{key}.{name}.bin")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".aot.", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _stale_reason(manifest: Dict[str, Any]) -> Optional[str]:
+    """Why a stored manifest cannot serve this process, or None. The
+    key already hashes the toolchain, so a mismatch here means a hash
+    collision or a hand-edited store — checked anyway: loading a
+    wrong-stack executable is undefined behavior, not a slow path."""
+    tc = _toolchain()
+    prov = manifest.get("provenance") or {}
+    for leg in ("jax_version", "platform", "devices", "device_kind"):
+        if prov.get(leg) != tc[leg]:
+            return f"{leg} {prov.get(leg)!r} != {tc[leg]!r}"
+    if manifest.get("schema") != AOT_SCHEMA:
+        return f"schema {manifest.get('schema')!r} != {AOT_SCHEMA}"
+    return None
+
+
+def _load_programs(
+    d: str, key: str, manifest: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Deserialize every program payload the manifest names. Raises on
+    any defect — the caller turns that into ``stale`` + recompile."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    out: Dict[str, Any] = {}
+    for name in manifest.get("programs") or []:
+        with open(_payload_path(d, key, name), "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+        out[name] = deserialize_and_load(payload, in_tree, out_tree)
+    if not out:
+        raise ValueError("manifest names no programs")
+    return out
+
+
+def _compile_now(solver, bucket: str):
+    """AOT-compile the solver's programs, measuring the trace+compile
+    stall into a ``compile_stall`` ledger event (the cost a cold process
+    pays; adopting the compiled objects means the first REQUEST does
+    not pay it again). Returns ``(compiled, stall_seconds)``."""
+    compiled: Dict[str, Any] = {}
+    t0 = time.monotonic()
+    for name, fn, args in solver.aot_programs():
+        compiled[name] = fn.lower(*args).compile()
+    stall = time.monotonic() - t0
+    obs.get().event(
+        "compile_stall",
+        bucket=bucket,
+        programs=sorted(compiled),
+        seconds=round(stall, 6),
+    )
+    return compiled, stall
+
+
+def _export(solver, d: str, key: str, compiled: Dict[str, Any]) -> bool:
+    """Serialize ``compiled`` into the store (manifest written LAST, so
+    a torn export is an absent entry, not a corrupt one). Fails soft."""
+    from jax.experimental.serialize_executable import serialize
+
+    try:
+        total = 0
+        for name, comp in compiled.items():
+            payload, in_tree, out_tree = serialize(comp)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+            total += len(blob)
+            _atomic_write(_payload_path(d, key, name), blob)
+        manifest = {
+            "schema": AOT_SCHEMA,
+            "key": key,
+            "programs": sorted(compiled),
+            "bucket": repr(solver.batch.bucket_key()),
+            "B": solver.B,
+            "batch_mesh": solver.batch_mesh,
+            "provenance": {
+                **_toolchain(),
+                "run_id": obs.get().run_id,
+                "created": time.time(),
+            },
+        }
+        _atomic_write(
+            _manifest_path(d, key),
+            (json.dumps(manifest, indent=1, sort_keys=True) + "\n").encode(),
+        )
+        obs.get().event(
+            "aot_export",
+            key=key,
+            dir=d,
+            programs=sorted(compiled),
+            bytes=total,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 - an unwritable store must
+        # degrade to compile-and-serve, never kill the bucket being warmed
+        log.warning("aot export failed (%s: %s) — serving uncached",
+                    type(e).__name__, e)
+        return False
+
+
+def warm(solver, directory: Optional[str] = None) -> Dict[str, Any]:
+    """Eliminate (or pay-and-measure) ``solver``'s compile stall.
+
+    Load path: a valid store entry deserializes straight to executables
+    (no trace, no compile) which are adopted into the solver —
+    ``aot_cache_hit`` with the measured ``load_s``. Miss/stale/disabled
+    path: AOT-compile NOW (``compile_stall`` event carries the measured
+    seconds), adopt, and — when the store is enabled — serialize for the
+    next process (``aot_export``). Returns a small report dict the
+    engine aggregates into its stats. Never raises for store defects;
+    only a genuinely uncompilable program propagates."""
+    report: Dict[str, Any] = {
+        "source": "jit", "outcome": None, "load_s": None,
+        "compile_stall_s": None,
+    }
+    bucket = repr(solver.batch.bucket_key())
+    d = aot_dir(directory)
+    if d is None:
+        compiled, stall = _compile_now(solver, bucket)
+        solver.adopt_executables(compiled)
+        report.update(
+            source="disabled", outcome="disabled", compile_stall_s=stall
+        )
+        return report
+    key = aot_key(solver)
+    report["key"] = key
+    mpath = _manifest_path(d, key)
+    manifest = None
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        obs.get().event("aot_cache_miss", key=key, dir=d, bucket=bucket)
+        report["outcome"] = "miss"
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        obs.get().event(
+            "aot_cache_stale", key=key, dir=d, bucket=bucket,
+            reason=f"unreadable manifest: {type(e).__name__}: {e}",
+        )
+        report["outcome"] = "stale"
+        manifest = None
+    if manifest is not None:
+        reason = _stale_reason(manifest)
+        if reason is None:
+            try:
+                t0 = time.monotonic()
+                programs = _load_programs(d, key, manifest)
+                solver.adopt_executables(programs)
+                load_s = time.monotonic() - t0
+                obs.get().event(
+                    "aot_cache_hit",
+                    key=key,
+                    dir=d,
+                    bucket=bucket,
+                    programs=sorted(programs),
+                    load_s=round(load_s, 6),
+                )
+                report.update(source="aot", outcome="hit", load_s=load_s)
+                return report
+            except Exception as e:  # noqa: BLE001 - torn payload, pjrt
+                # refusal, pickle drift: all degrade to recompile
+                reason = f"payload load failed: {type(e).__name__}: {e}"
+        obs.get().event(
+            "aot_cache_stale", key=key, dir=d, bucket=bucket, reason=reason
+        )
+        report["outcome"] = "stale"
+    compiled, stall = _compile_now(solver, bucket)
+    solver.adopt_executables(compiled)
+    report.update(source="compiled", compile_stall_s=stall)
+    if report["outcome"] is None:
+        report["outcome"] = "miss"
+    report["exported"] = _export(solver, d, key, compiled)
+    return report

@@ -1,0 +1,20 @@
+"""Always-on async serving engine (docs/SERVING.md "Async engine & cold
+start").
+
+The continuously-batching counterpart of :class:`~heat3d_tpu.serve.queue
+.ScenarioQueue`: submissions are accepted WHILE batches execute — a
+dispatcher loop packs pending requests into shape-bucketed batches and
+hands them to per-bucket worker threads, each of which builds (and
+AOT-warms, serve/aot.py) its bucket's compiled ensemble once and then
+holds the device futures of one in-flight batch at a time. Results
+deliver in submission order per request stream; per-bucket latency
+stats, backpressure caps, and the drain-final ``serve_metrics_summary``
+event are shared with the synchronous queue, so the PR 8 SLO layer
+judges both front-ends identically.
+"""
+
+from heat3d_tpu.serve.engine.core import (  # noqa: F401
+    AsyncServeEngine,
+    DEFAULT_WORKERS,
+    ENV_WORKERS,
+)

@@ -1,0 +1,655 @@
+"""The continuously-batching async serving engine.
+
+``ScenarioQueue`` (serve/queue.py) is submit-then-drain: ``drain()``
+holds the caller while every batch executes, and nothing can be
+submitted meanwhile — correct for certification, wrong for a service.
+This engine is the always-on posture the ROADMAP's "millions of users"
+axis needs (the GPU-aware-async-tasks paper's thesis: the scaling win is
+overlapping dispatch with in-flight work):
+
+- :meth:`AsyncServeEngine.submit` is thread-safe, returns immediately,
+  and applies the SAME explicit backpressure contract as the queue
+  (``HEAT3D_SERVE_QUEUE`` outstanding-request cap — raises, never
+  silently unbounded);
+- a **dispatcher thread** packs whatever is pending into shape-bucketed
+  chunks (the queue's own bucketing/padding helpers) and hands each to
+  its bucket's worker the moment that worker is free — continuous
+  batching: requests arriving while a batch flies ride the NEXT batch,
+  not a global barrier;
+- **per-bucket worker threads** own their bucket's compiled ensembles
+  (AOT-warmed through serve/aot.py at first touch, so a fresh process
+  with a warm store serves its first request with no trace+compile
+  stall), execute one batch at a time, and block on the device futures
+  (``gather`` / ``block_until_ready``) without stalling submission or
+  other buckets. Total concurrent batches are capped by
+  ``HEAT3D_SERVE_WORKERS`` execution slots;
+- **delivery preserves submission order per request stream** (the
+  ``stream`` tag at submit): within a stream, results yield strictly in
+  submit order; across streams, a slow stream never blocks a fast one;
+- a failed bucket (bad config, uncompilable route) fails ONLY its own
+  requests — every other bucket's in-flight and future results still
+  deliver, and the failures are surfaced explicitly
+  (:attr:`AsyncServeEngine.failures`, and :meth:`drain` re-raises after
+  streaming what landed — the queue's contract);
+- :meth:`shutdown` is graceful: stop accepting, run down every
+  dispatched batch, join the workers, close with ONE
+  ``serve_metrics_summary`` event (the SLO layer's source, same shape
+  as the queue's).
+
+Ledger: ``serve_submit`` / ``serve_batch_start`` / ``serve_batch`` span /
+``serve_result`` / ``serve_metrics_summary`` exactly as the queue emits
+them, plus the engine's own ``serve_dispatch`` (dispatcher handed a
+packed chunk to a worker) and ``serve_batch_ready`` (a batch's device
+futures resolved — the dispatch→ready gap is the overlap window the
+timeline shows) and the serve/aot.py events.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as stdqueue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from heat3d_tpu import obs
+from heat3d_tpu.core.config import SolverConfig
+from heat3d_tpu.serve.ensemble import EnsembleSolver
+from heat3d_tpu.serve.queue import (
+    DEFAULT_MAX_BATCH,
+    DEFAULT_QUEUE_DEPTH,
+    ENV_MAX_BATCH,
+    ENV_QUEUE_DEPTH,
+    ServeResult,
+    ServeStats,
+    _env_int,
+    _padded_size,
+    build_chunk_results,
+    pad_batch,
+    run_packed_batch,
+)
+from heat3d_tpu.serve.scenario import Scenario, solver_bucket_key
+from heat3d_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_WORKERS = "HEAT3D_SERVE_WORKERS"
+DEFAULT_WORKERS = 2
+
+# request lifecycle states
+_PENDING = "pending"
+_DISPATCHED = "dispatched"
+_DONE = "done"
+_FAILED = "failed"
+_CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class _Tracked:
+    request_id: int
+    base: SolverConfig
+    scenario: Scenario
+    stream: str
+    submitted_at: float
+    state: str = _PENDING
+    result: Optional[ServeResult] = None
+    error: Optional[str] = None
+
+
+class _BucketWorker(threading.Thread):
+    """One bucket's executor: owns the bucket's solver cache (and its
+    AOT warm-up) and runs one packed batch at a time off its own queue.
+    ``None`` is the shutdown sentinel."""
+
+    def __init__(self, engine: "AsyncServeEngine", bucket: str):
+        super().__init__(name=f"heat3d-serve-{bucket[:24]}", daemon=True)
+        self.engine = engine
+        self.bucket = bucket
+        self.q: "stdqueue.Queue[Optional[List[_Tracked]]]" = stdqueue.Queue()
+        self.solvers: Dict[Tuple, EnsembleSolver] = {}
+        self.start()
+
+    def run(self) -> None:
+        while True:
+            chunk = self.q.get()
+            if chunk is None:
+                return
+            # the global execution-slot cap (HEAT3D_SERVE_WORKERS): more
+            # buckets than slots queue here rather than oversubscribing
+            # the device
+            with self.engine._slots:
+                try:
+                    self.engine._run_batch(self, chunk)
+                except BaseException as e:  # noqa: BLE001 - a worker
+                    # must never die silently: fail its chunk, keep
+                    # serving later batches (a transient error must not
+                    # wedge the bucket forever)
+                    self.engine._fail_chunk(chunk, e)
+            with self.engine._cond:
+                self.engine._busy.discard(self.bucket)
+                self.engine._cond.notify_all()
+
+    def solver_for(self, batch, padded: int) -> EnsembleSolver:
+        key = (batch.bucket_key(), padded, self.engine.batch_mesh)
+        solver = self.solvers.get(key)
+        if solver is None:
+            solver = EnsembleSolver(
+                batch, batch_mesh=self.engine.batch_mesh, bind="traced"
+            )
+            if self.engine._aot:
+                from heat3d_tpu.serve import aot
+
+                report = aot.warm(solver, self.engine._aot_dir)
+                self.engine._note_aot(report)
+            self.solvers[key] = solver
+        else:
+            # same structure, new member values: rebind coefficients;
+            # the compiled (possibly AOT-loaded) programs are reused
+            solver.batch = batch
+            solver._build_coefficients()
+        return solver
+
+
+class AsyncServeEngine:
+    """Submit scenarios from any thread; batches execute continuously.
+
+    Usage::
+
+        with AsyncServeEngine(batch_mesh=1) as eng:
+            rid = eng.submit(base, Scenario(alpha=0.5), stream="tenant-a")
+            ...                       # keep submitting — batches fly now
+            for r in eng.results():   # per-stream submission order
+                handle(r)
+        # __exit__ -> shutdown(): graceful run-down + serve_metrics_summary
+
+    ``before_execute`` is an instrumentation hook called in the worker
+    thread immediately before a batch's device work ``(bucket,
+    request_ids)`` — tests pin the submit-while-in-flight overlap with
+    it; production leaves it None.
+    """
+
+    def __init__(
+        self,
+        max_batch: Optional[int] = None,
+        max_depth: Optional[int] = None,
+        batch_mesh: int = 1,
+        workers: Optional[int] = None,
+        snapshot_every: int = 0,
+        with_residuals: bool = False,
+        aot: Optional[bool] = None,
+        aot_dir: Optional[str] = None,
+        before_execute: Optional[Callable[[str, List[int]], None]] = None,
+        autostart: bool = True,
+    ):
+        self.max_batch = max_batch or _env_int(ENV_MAX_BATCH, DEFAULT_MAX_BATCH)
+        self.max_depth = max_depth or _env_int(
+            ENV_QUEUE_DEPTH, DEFAULT_QUEUE_DEPTH
+        )
+        self.batch_mesh = batch_mesh
+        self.snapshot_every = snapshot_every
+        self.with_residuals = with_residuals
+        self.workers = workers or _env_int(ENV_WORKERS, DEFAULT_WORKERS)
+        self._aot_dir = aot_dir
+        # aot=None: enabled (serve/aot.py decides store-vs-measure-only
+        # from HEAT3D_AOT_CACHE — an env-disabled store still warms with
+        # the stall measured, just persists nothing). aot=False: raw jit
+        # dispatch — the debugging escape where the first request pays a
+        # hidden stall.
+        self._aot = True if aot is None else bool(aot)
+        self.before_execute = before_execute
+
+        self._cond = threading.Condition()
+        self._req: Dict[int, _Tracked] = {}
+        # open = everything the engine still holds memory for — pending,
+        # in flight, AND completed-but-undelivered results (each of those
+        # is a gathered full-grid field). Maintained incrementally (an
+        # always-on service must not scan its request history per
+        # submit), decremented only at delivery/failure/cancel, so the
+        # HEAT3D_SERVE_QUEUE cap bounds engine memory even when the
+        # results() consumer is slower than batch throughput.
+        self._open = 0
+        self._next_id = 0
+        self._streams: Dict[str, List[int]] = {}
+        self._workers: Dict[str, _BucketWorker] = {}
+        self._busy: set = set()
+        self._slots = threading.Semaphore(self.workers)
+        self._stop = False
+        self._joined = False
+        self._stats = ServeStats()
+        self.failures: List[Dict[str, Any]] = []
+        self._unraised_failures: List[Dict[str, Any]] = []
+        self._summary_dirty = False
+        # overlap/in-flight accounting (stats() + the acceptance tests)
+        self._in_flight = 0
+        self._max_in_flight = 0
+        self._accepted_in_flight = 0
+        self._cancelled = 0
+        self._aot_stats = {
+            "hits": 0, "misses": 0, "stale": 0, "disabled": 0,
+            "exports": 0, "compile_stall_s": 0.0, "load_s": 0.0,
+        }
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="heat3d-serve-dispatch",
+            daemon=True,
+        )
+        self._started = False
+        # autostart=False defers dispatching until start() (or the first
+        # results()/drain()/shutdown() call): a caller enqueueing an
+        # initial burst gets one optimally-packed batch per bucket
+        # instead of a timing-dependent split — which also makes the
+        # batch composition (and therefore the AOT store's padded-size
+        # keys) deterministic for a fixed request set.
+        if autostart:
+            self.start()
+
+    def start(self) -> None:
+        """Begin dispatching (idempotent; no-op after autostart)."""
+        with self._cond:
+            if self._started:
+                return
+            self._started = True
+        self._dispatcher.start()
+
+    # ---- context manager ---------------------------------------------------
+
+    def __enter__(self) -> "AsyncServeEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.shutdown(wait=exc_type is None)
+        return False
+
+    # ---- submission --------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._cond:
+            return self._open
+
+    def submit(
+        self,
+        base: SolverConfig,
+        scenario: Scenario,
+        stream: str = "",
+    ) -> int:
+        """Enqueue one scenario; returns the request id. Thread-safe and
+        non-blocking: batches already in flight keep flying. Raises when
+        the engine holds ``HEAT3D_SERVE_QUEUE`` requests (pending +
+        in-flight + completed-but-undelivered — the cap bounds engine
+        MEMORY, so a slow results() consumer backpressures submitters)
+        — or after :meth:`shutdown`."""
+        if scenario.steps is None:
+            # materialize the budget at SUBMIT time (the queue's rule):
+            # budgets are traced inputs, not bucket structure, so a
+            # default-budget scenario must not inherit another base's
+            # step count at packing time
+            scenario = dataclasses.replace(scenario, steps=base.run.num_steps)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError(
+                    "engine is shut down — no further submissions"
+                )
+            if self._open >= self.max_depth:
+                raise RuntimeError(
+                    f"serve queue full ({self.max_depth} outstanding; "
+                    f"{ENV_QUEUE_DEPTH} raises the cap) — wait for "
+                    "deliveries before submitting more"
+                )
+            rid = self._next_id
+            self._next_id += 1
+            self._open += 1
+            self._req[rid] = _Tracked(
+                request_id=rid,
+                base=base,
+                scenario=scenario,
+                stream=stream,
+                submitted_at=time.monotonic(),
+            )
+            self._streams.setdefault(stream, []).append(rid)
+            if self._in_flight > 0:
+                # the overlap the engine exists for: this submission was
+                # accepted while a batch executed (test-pinned)
+                self._accepted_in_flight += 1
+            depth = self._open
+            self._cond.notify_all()
+        self._stats.observe_depth(depth)
+        obs.get().event(
+            "serve_submit",
+            request_id=rid,
+            grid=list(base.grid.shape),
+            stencil=base.stencil.kind,
+            steps=scenario.steps,
+            queue_depth=depth,
+            stream=stream or None,
+            in_flight=self._in_flight,
+        )
+        return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a not-yet-dispatched request. True when cancelled;
+        False when unknown, already dispatched (in flight — results are
+        coming), or already resolved. Cancelled requests never deliver
+        and never count as failures."""
+        with self._cond:
+            r = self._req.get(rid)
+            if r is None or r.state != _PENDING:
+                return False
+            r.state = _CANCELLED
+            self._cancelled += 1
+            self._open -= 1
+            self._cond.notify_all()
+            return True
+
+    # ---- the dispatcher loop ----------------------------------------------
+
+    def _undispatched(self) -> List[_Tracked]:
+        return [r for r in self._req.values() if r.state == _PENDING]
+
+    def _pack(self) -> List[Tuple[_BucketWorker, List[_Tracked]]]:
+        """Under the lock: one chunk per idle-bucket, submission order
+        preserved inside each bucket (the packing rule the queue uses)."""
+        by_bucket: Dict[str, List[_Tracked]] = {}
+        for r in self._undispatched():
+            by_bucket.setdefault(str(solver_bucket_key(r.base)), []).append(r)
+        out: List[Tuple[_BucketWorker, List[_Tracked]]] = []
+        for bucket, reqs in by_bucket.items():
+            if bucket in self._busy:
+                # continuous batching: this bucket's worker is flying a
+                # batch; everything pending for it packs the NEXT one
+                continue
+            worker = self._workers.get(bucket)
+            if worker is None:
+                worker = _BucketWorker(self, bucket)
+                self._workers[bucket] = worker
+            chunk = reqs[: self.max_batch]
+            for r in chunk:
+                r.state = _DISPATCHED
+            self._busy.add(bucket)
+            out.append((worker, chunk))
+        return out
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    assignments = self._pack()
+                    if assignments:
+                        break
+                    if self._stop and not self._undispatched():
+                        return
+                    self._cond.wait()
+            for worker, chunk in assignments:
+                obs.get().event(
+                    "serve_dispatch",
+                    bucket=worker.bucket,
+                    members=len(chunk),
+                    request_ids=[r.request_id for r in chunk],
+                    in_flight=self._in_flight,
+                )
+                worker.q.put(chunk)
+
+    # ---- batch execution (worker threads) ---------------------------------
+
+    def _run_batch(self, worker: _BucketWorker, chunk: List[_Tracked]) -> None:
+        with self._cond:
+            self._in_flight += 1
+            self._max_in_flight = max(self._max_in_flight, self._in_flight)
+        try:
+            base = chunk[0].base
+            members = [r.scenario for r in chunk]
+            padded = _padded_size(
+                len(members), self.max_batch, self.batch_mesh
+            )
+            batch = pad_batch(base, members, padded)
+            solver = worker.solver_for(batch, padded)
+            self._stats.observe_batch(len(chunk))
+            bucket_s = str(batch.bucket_key())
+            obs.get().event(
+                "serve_batch_start",
+                members=len(chunk),
+                padded=padded,
+                request_ids=[r.request_id for r in chunk],
+                bucket=bucket_s,
+                mesh=list(solver.cfg.mesh.shape),
+                batch_mesh=solver.batch_mesh,
+                time_blocking=solver.cfg.time_blocking,
+            )
+            budgets = np.asarray(
+                [batch.member_steps(m) for m in range(len(batch))], np.int32
+            )
+            if self.before_execute is not None:
+                self.before_execute(
+                    bucket_s, [r.request_id for r in chunk]
+                )
+            with obs.get().span(
+                "serve_batch", members=len(chunk), padded=padded
+            ) as span:
+                fields, residuals, snapshots = run_packed_batch(
+                    solver, budgets,
+                    snapshot_every=self.snapshot_every,
+                    with_residuals=self.with_residuals,
+                )
+                span.add(steps_total=int(budgets.sum()))
+            # the device futures this worker held just resolved — the
+            # dispatch->ready window is where submission overlapped
+            obs.get().event(
+                "serve_batch_ready",
+                bucket=bucket_s,
+                members=len(chunk),
+                execute_s=round(span.dur_s or 0.0, 6),
+                in_flight=self._in_flight,
+            )
+        except BaseException as e:  # noqa: BLE001 - fail THIS chunk only
+            self._fail_chunk(chunk, e)
+            return
+        finally:
+            with self._cond:
+                self._in_flight -= 1
+        results = build_chunk_results(
+            [(r.request_id, r.submitted_at) for r in chunk],
+            bucket_s, budgets, fields, residuals, snapshots, self._stats,
+        )
+        with self._cond:
+            for r, res in zip(chunk, results):
+                r.result = res
+                r.state = _DONE
+            self._summary_dirty = True
+            self._cond.notify_all()
+        self._stats.observe_depth(len(self))
+
+    def _fail_chunk(self, chunk: List[_Tracked], exc: BaseException) -> None:
+        err = f"{type(exc).__name__}: {str(exc)[:300]}"
+        log.warning("serve batch failed (%s request(s)): %s", len(chunk), err)
+        with self._cond:
+            for r in chunk:
+                if r.state in (_DONE, _FAILED):
+                    continue
+                r.state = _FAILED
+                r.error = err
+                self._open -= 1
+                rec = {
+                    "request_id": r.request_id,
+                    "stream": r.stream,
+                    "error": err,
+                }
+                self.failures.append(rec)
+                self._unraised_failures.append(rec)
+            self._summary_dirty = True
+            self._cond.notify_all()
+
+    def _note_aot(self, report: Dict[str, Any]) -> None:
+        with self._cond:
+            st = self._aot_stats
+            outcome = report.get("outcome")
+            if outcome == "hit":
+                st["hits"] += 1
+            elif outcome == "miss":
+                st["misses"] += 1
+            elif outcome == "stale":
+                st["stale"] += 1
+            elif outcome == "disabled":
+                st["disabled"] += 1
+            if report.get("exported"):
+                st["exports"] += 1
+            if report.get("compile_stall_s"):
+                st["compile_stall_s"] += float(report["compile_stall_s"])
+            if report.get("load_s"):
+                st["load_s"] += float(report["load_s"])
+
+    # ---- delivery ----------------------------------------------------------
+
+    def _pop_next(self) -> Optional[ServeResult]:
+        """Under the lock: the single NEXT deliverable result across
+        streams (submission order within each stream; FAILED/CANCELLED
+        requests are skipped — they surface via :attr:`failures` /
+        :meth:`drain` and never block the stream behind them), pruning
+        the consumed prefix as it goes. One at a time BY DESIGN: a
+        result leaves the engine's bookkeeping only at the moment it is
+        handed to the consumer, so an abandoned ``results()`` iterator
+        cannot strand already-popped results — and the prune keeps an
+        always-on engine from retaining every request it ever served
+        (each _Tracked holds the scenario, possibly a full-grid init
+        array; each DONE result a gathered field)."""
+        for stream, rids in list(self._streams.items()):
+            i = 0
+            res: Optional[ServeResult] = None
+            while i < len(rids):
+                r = self._req[rids[i]]
+                if r.state in (_FAILED, _CANCELLED):
+                    i += 1
+                    continue
+                if r.state == _DONE:
+                    res = r.result
+                    self._open -= 1
+                    i += 1
+                break
+            if i:
+                for rid in rids[:i]:
+                    self._req.pop(rid, None)
+                del rids[:i]
+            if not rids:
+                # a drained stream tag must not live forever: per-tenant
+                # stream names would otherwise leak one entry each and
+                # put every delivery at O(streams ever seen)
+                del self._streams[stream]
+            if res is not None:
+                return res
+        return None
+
+    def _outstanding(self) -> bool:
+        return any(
+            r.state in (_PENDING, _DISPATCHED, _DONE)
+            for r in self._req.values()
+        )
+
+    def results(self, timeout: Optional[float] = None) -> Iterator[ServeResult]:
+        """Yield results as they become deliverable — submission order
+        within each stream, streams interleaved by completion. Returns
+        when nothing submitted remains undelivered (new submissions
+        while iterating extend the iteration). ``timeout`` bounds the
+        TOTAL wait; expiry raises ``TimeoutError``."""
+        self.start()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cond:
+                res = self._pop_next()
+                if res is None:
+                    if not self._outstanding():
+                        return
+                    left = (
+                        None if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if left is not None and left <= 0:
+                        raise TimeoutError(
+                            f"serve results: {len(self)} request(s) still "
+                            f"outstanding after {timeout}s"
+                        )
+                    self._cond.wait(left)
+                    continue
+            yield res
+
+    def drain(self, timeout: Optional[float] = None) -> Iterator[ServeResult]:
+        """The queue-compatible collector: wait for everything submitted,
+        yield it (per-stream submission order), close with ONE
+        ``serve_metrics_summary`` event, then — like
+        ``ScenarioQueue.drain`` — re-raise if any bucket failed (after
+        streaming everything that landed; the failed requests are listed
+        in :attr:`failures`). Unlike the queue, submission stays open
+        while draining: batches keep executing underneath."""
+        yield from self.results(timeout=timeout)
+        self._emit_summary()
+        with self._cond:
+            unraised, self._unraised_failures = self._unraised_failures, []
+        if unraised:
+            raise RuntimeError(
+                f"{len(unraised)} request(s) failed "
+                f"(first: request {unraised[0]['request_id']}: "
+                f"{unraised[0]['error']}); delivered results already "
+                "streamed — failed requests were NOT delivered"
+            )
+
+    # ---- summary / stats / shutdown ---------------------------------------
+
+    def metrics_summary(self) -> Dict[str, Any]:
+        """The live SLO source (``serve --async --slo``): same shape as
+        ``ScenarioQueue.metrics_summary`` — the SLO layer cannot tell
+        which front-end produced it."""
+        return self._stats.summary(pending=len(self))
+
+    def _emit_summary(self) -> None:
+        with self._cond:
+            if not self._summary_dirty:
+                return
+            self._summary_dirty = False
+        obs.get().event("serve_metrics_summary", **self.metrics_summary())
+
+    def stats(self) -> Dict[str, Any]:
+        """Engine-side counters (the CLI verdict's payload): submission /
+        delivery / failure totals, the in-flight high-water mark, how
+        many submissions were accepted while batches flew (the overlap
+        proof), and the AOT warm-up aggregate."""
+        with self._cond:
+            return {
+                "submitted": self._next_id,
+                "delivered": self._stats.delivered,
+                "failed": len(self.failures),
+                "cancelled": self._cancelled,
+                "batches": self._stats.batches,
+                "buckets": len(self._workers),
+                "workers": self.workers,
+                "max_in_flight": self._max_in_flight,
+                "accepted_in_flight": self._accepted_in_flight,
+                "aot": dict(self._aot_stats),
+            }
+
+    def shutdown(self, wait: bool = True, cancel_pending: bool = False) -> None:
+        """Graceful stop: refuse new submissions, run down everything
+        dispatched (and, unless ``cancel_pending``, everything pending),
+        join the workers, and close with the drain-final
+        ``serve_metrics_summary`` if anything executed since the last
+        one. Idempotent. ``wait=False`` abandons pending work (requests
+        stay undelivered; in-flight device work still completes in the
+        daemon workers but is not waited for)."""
+        self.start()  # an unstarted engine still runs down its pending
+        with self._cond:
+            if self._joined:
+                return
+            self._stop = True
+            if cancel_pending or not wait:
+                for r in self._undispatched():
+                    r.state = _CANCELLED
+                    self._cancelled += 1
+                    self._open -= 1
+            self._cond.notify_all()
+        if wait:
+            self._dispatcher.join()
+            workers = list(self._workers.values())
+            for w in workers:
+                w.q.put(None)
+            for w in workers:
+                w.join()
+            with self._cond:
+                self._joined = True
+        self._emit_summary()

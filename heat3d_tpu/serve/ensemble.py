@@ -455,10 +455,77 @@ class EnsembleSolver:
         )
         W, C, BCV = self._coef_args()
         budgets = jax.ShapeDtypeStruct((self.B,), jnp.int32)
+        return self._program_triples(u, W, C, BCV, budgets)
+
+    # ---- AOT export/adoption (serve/aot.py) -------------------------------
+
+    # name -> dispatcher attribute: THE registry of the traced bind's
+    # shared programs. ir_programs, aot_programs, and adopt_executables
+    # all derive from it, so a new program (a superstep variant, say)
+    # added here + in _program_triples' arg map is certified AND
+    # AOT-cached — there is no third hand-kept list to miss.
+    _PROGRAM_ATTRS = (("run", "_run_p"), ("step_residual", "_step_res_p"))
+
+    def _program_triples(self, u, W, C, BCV, budgets):
+        """(name, dispatcher, args) for every shared traced-bind
+        program, given the caller's avals (the IR verifier passes plain
+        shapes, the AOT cache sharding-annotated ones)."""
+        args = {
+            "run": (u, W, C, BCV, budgets),
+            "step_residual": (u, W, C, BCV),
+        }
         return [
-            ("run", self._run_p, (u, W, C, BCV, budgets)),
-            ("step_residual", self._step_res_p, (u, W, C, BCV)),
+            (name, getattr(self, attr), args[name])
+            for name, attr in self._PROGRAM_ATTRS
         ]
+
+    def aot_programs(self):
+        """The traced-bind programs as ``(name, jit_fn, abstract_args)``
+        for ahead-of-time compilation: ``fn.lower(*args).compile()``
+        yields exactly the executable the first :meth:`run` /
+        :meth:`step_with_member_residuals` call would have compiled.
+        Args are sharding-annotated ``ShapeDtypeStruct``s (the compiled
+        program is layout-strict, so the abstract avals must pin the
+        same shardings the runtime inputs carry). Baked binding has no
+        shared program to AOT — its solo executables are per-member."""
+        if self.bind != "traced":
+            return []
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+
+        u = jax.ShapeDtypeStruct(
+            (self.B,) + tuple(self.cfg.padded_shape),
+            self.storage_dtype,
+            sharding=self.sharding,
+        )
+        W, C, BCV = (sds(a) for a in self._coef_args())
+        budgets = jax.ShapeDtypeStruct(
+            (self.B,), jnp.int32, sharding=self._member_spec
+        )
+        return self._program_triples(u, W, C, BCV, budgets)
+
+    def adopt_executables(self, programs) -> None:
+        """Swap AOT-compiled executables in for the jit dispatchers —
+        the cold-start elimination hook (serve/aot.py): after adoption,
+        the first request dispatches straight into the loaded PJRT
+        executable with no trace and no compile. Coefficient REBINDS
+        (the queue/engine's bucket reuse) survive adoption: rebinding
+        replaces the uploaded arrays, not the programs."""
+        if self.bind != "traced":
+            raise ValueError(
+                "adopt_executables: only the traced binding has shared "
+                "programs (baked dispatches per-member solo executables)"
+            )
+        known = dict(self._PROGRAM_ATTRS)
+        unknown = sorted(set(programs) - set(known))
+        if unknown:
+            raise ValueError(
+                f"adopt_executables: unknown program name(s) {unknown} "
+                f"(have {sorted(known)})"
+            )
+        for name, comp in programs.items():
+            setattr(self, known[name], comp)
 
     # ---- stepping ---------------------------------------------------------
 

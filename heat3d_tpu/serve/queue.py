@@ -100,6 +100,194 @@ class _Pending:
     submitted_at: float
 
 
+def pad_batch(
+    base: SolverConfig, members: List[Scenario], padded: int
+) -> ScenarioBatch:
+    """The executed :class:`ScenarioBatch`: ``members`` plus 0-step dummy
+    copies of the first member up to ``padded`` (masked out after the
+    first bound computation, never delivered). Shared by the synchronous
+    queue and the async engine so both execute the identical program."""
+    fill = padded - len(members)
+    if fill > 0:
+        members = members + [
+            dataclasses.replace(members[0], steps=0) for _ in range(fill)
+        ]
+    return ScenarioBatch(base, members)
+
+
+def run_packed_batch(
+    solver: EnsembleSolver,
+    budgets: np.ndarray,
+    snapshot_every: int = 0,
+    with_residuals: bool = False,
+):
+    """One packed batch's device work — init, (chunked) run, gather,
+    optional residual probe — returning ``(fields, residuals,
+    snapshots)``. This is THE execution body both the synchronous
+    queue and the async engine (serve/engine) drive: byte-identical
+    results between the two are a consequence of sharing it, not a
+    test-maintained coincidence."""
+    u = solver.init_state()
+    snapshots: Optional[List[np.ndarray]] = None
+    if snapshot_every > 0:
+        snapshots = []
+        done = np.zeros_like(budgets)
+        while (done < budgets).any():
+            stride = np.minimum(budgets - done, snapshot_every).astype(
+                np.int32
+            )
+            u = solver.run(u, stride)
+            done = done + stride
+            snapshots.append(solver.gather(u))
+    else:
+        u = solver.run(u, budgets)
+    # the last snapshot already gathered the final state — don't pay a
+    # second full-batch device-to-host transfer for it
+    fields = snapshots[-1] if snapshots else solver.gather(u)
+    residuals = None
+    if with_residuals:
+        # the residual costs one probe update per member — a health
+        # signal measured FROM the delivered state. Fields are gathered
+        # first (the probe donates u), so delivered results stay at
+        # exactly the budgeted step either way.
+        u, r2 = solver.step_with_member_residuals(u)
+        residuals = np.asarray(r2)
+    return fields, residuals, snapshots
+
+
+def build_chunk_results(
+    requests: List[Tuple[int, float]],
+    bucket: str,
+    budgets: np.ndarray,
+    fields,
+    residuals,
+    snapshots,
+    stats: "ServeStats",
+) -> List[ServeResult]:
+    """``(request_id, submitted_at)`` pairs → delivered
+    :class:`ServeResult`s: the per-request latency observation,
+    ``serve_result`` ledger event, and result assembly (snapshot
+    slicing, residual conversion). Shared by the synchronous queue and
+    the async engine for the same reason as :func:`run_packed_batch` —
+    the delivered payload cannot diverge between front-ends if there is
+    only one assembler."""
+    out: List[ServeResult] = []
+    now = time.monotonic()
+    for i, (rid, submitted_at) in enumerate(requests):
+        latency = now - submitted_at
+        stats.observe_result(bucket, latency)
+        obs.get().event(
+            "serve_result",
+            request_id=rid,
+            steps=int(budgets[i]),
+            batch_members=len(requests),
+            queue_latency_s=round(latency, 6),
+        )
+        out.append(
+            ServeResult(
+                request_id=rid,
+                field=fields[i],
+                steps=int(budgets[i]),
+                residual_sumsq=(
+                    float(residuals[i]) if residuals is not None else None
+                ),
+                batch_size=len(requests),
+                queue_latency_s=latency,
+                snapshots=(
+                    [s[i] for s in snapshots]
+                    if snapshots is not None
+                    else None
+                ),
+            )
+        )
+    return out
+
+
+class ServeStats:
+    """Cumulative serve-health tracking shared by the synchronous queue
+    and the async engine: per-bucket queue-latency reservoirs (bounded by
+    the metrics layer's ``HISTOGRAM_SAMPLE_CAP`` — count/max stay exact
+    past it, percentiles note ``clipped``), the pending-depth high-water
+    mark, batch/delivery counters, and the live metrics-registry mirrors
+    (queue-depth gauge, latency/batch-size histograms). Thread-safe: the
+    engine's bucket workers observe concurrently."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._bucket_stats: Dict[str, Dict] = {}
+        self.depth_max = 0
+        self.batches = 0
+        self.delivered = 0
+        self._depth_gauge = obs.REGISTRY.gauge(
+            "serve_queue_depth", "pending scenario requests"
+        )
+        self._latency_hist = obs.REGISTRY.histogram(
+            "serve_request_latency_seconds",
+            "submit -> result delivery per request",
+        )
+        self._batch_hist = obs.REGISTRY.histogram(
+            "serve_batch_members", "members packed per executed batch"
+        )
+
+    def observe_depth(self, depth: int) -> None:
+        self._depth_gauge.set(depth)
+        with self._lock:
+            self.depth_max = max(self.depth_max, depth)
+
+    def observe_batch(self, members: int) -> None:
+        self._batch_hist.observe(members)
+        with self._lock:
+            self.batches += 1
+
+    def observe_result(self, bucket: str, latency_s: float) -> None:
+        # bucket-labelled: the SLO layer judges latency PER BUCKET (a
+        # big-grid bucket legitimately runs slower than a small one)
+        self._latency_hist.observe(latency_s, bucket=bucket)
+        with self._lock:
+            st = self._bucket_stats.setdefault(
+                bucket,
+                {"count": 0, "max": 0.0, "samples": [], "clipped": False},
+            )
+            st["count"] += 1
+            st["max"] = max(st["max"], latency_s)
+            if len(st["samples"]) < HISTOGRAM_SAMPLE_CAP:
+                st["samples"].append(latency_s)
+            else:
+                st["clipped"] = True
+            self.delivered += 1
+
+    def summary(self, pending: int) -> Dict[str, object]:
+        """The ``serve_metrics_summary`` payload: per-bucket latency
+        count/p50/p95/max, depth high-water mark, batch/delivery
+        counters — the dict the SLO layer evaluates (obs/perf/slo.py),
+        identical in shape whichever front-end produced it."""
+        from heat3d_tpu.obs.metrics import percentile
+
+        with self._lock:
+            buckets = {}
+            for bucket, st in sorted(self._bucket_stats.items()):
+                rec = {
+                    "count": st["count"],
+                    "p50_s": round(percentile(st["samples"], 50), 6),
+                    "p95_s": round(percentile(st["samples"], 95), 6),
+                    "max_s": round(st["max"], 6),
+                }
+                if st["clipped"]:
+                    # percentiles cover the stored reservoir only, never
+                    # to be mistaken for exact (count/max stay exact)
+                    rec["clipped"] = True
+                buckets[bucket] = rec
+            return {
+                "buckets": buckets,
+                "depth_max": self.depth_max,
+                "batches": self.batches,
+                "delivered": self.delivered,
+                "pending": pending,
+            }
+
+
 class ScenarioQueue:
     """Submit scenarios, drain shape-bucketed batches, stream results.
 
@@ -131,29 +319,20 @@ class ScenarioQueue:
         # cumulative per-bucket latency stats + queue-depth high-water
         # mark: the drain-final serve_metrics_summary event reports these
         # so post-hoc SLO evaluation (obs/perf/slo.py) never needs the
-        # live registry. The sample reservoir is bounded by the SAME cap
-        # as the metrics layer (a service queue lives for millions of
-        # requests; count/max stay exact past the cap, percentiles note
-        # `clipped` — obs.metrics's rule).
-        self._bucket_stats: Dict[str, Dict] = {}
-        self._depth_max = 0
-        self._batches = 0
-        self._delivered = 0
-        self._depth_gauge = obs.REGISTRY.gauge(
-            "serve_queue_depth", "pending scenario requests"
-        )
-        self._latency_hist = obs.REGISTRY.histogram(
-            "serve_request_latency_seconds",
-            "submit -> result delivery per request",
-        )
-        self._batch_hist = obs.REGISTRY.histogram(
-            "serve_batch_members", "members packed per executed batch"
-        )
+        # live registry (ServeStats — shared with the async engine so the
+        # SLO layer judges both front-ends from one summary shape).
+        self._stats = ServeStats()
 
     # ---- submission -------------------------------------------------------
 
     def __len__(self) -> int:
         return len(self._pending)
+
+    @property
+    def _bucket_stats(self) -> Dict[str, Dict]:
+        # introspection view of the shared stats (tests assert the
+        # reservoir bound here)
+        return self._stats._bucket_stats
 
     def submit(self, base: SolverConfig, scenario: Scenario) -> int:
         """Enqueue one scenario over structural config ``base``; returns
@@ -182,8 +361,7 @@ class ScenarioQueue:
             scenario=scenario,
             submitted_at=time.monotonic(),
         )
-        self._depth_gauge.set(len(self._pending))
-        self._depth_max = max(self._depth_max, len(self._pending))
+        self._stats.observe_depth(len(self._pending))
         obs.get().event(
             "serve_submit",
             request_id=rid,
@@ -219,18 +397,6 @@ class ScenarioQueue:
             solver.batch = batch
             solver._build_coefficients()
         return solver
-
-    def _pad_batch(
-        self, base: SolverConfig, members: List[Scenario], padded: int
-    ) -> ScenarioBatch:
-        fill = padded - len(members)
-        if fill > 0:
-            # dummy members run 0 steps (masked out after the first
-            # bound computation) and are never delivered
-            members = members + [
-                dataclasses.replace(members[0], steps=0) for _ in range(fill)
-            ]
-        return ScenarioBatch(base, members)
 
     # ---- execution --------------------------------------------------------
 
@@ -270,28 +436,7 @@ class ScenarioQueue:
         mark, and batch/delivery counters — the dict the drain-final
         ``serve_metrics_summary`` ledger event carries and ``heat3d serve
         --slo`` evaluates live (obs/perf/slo.py)."""
-        from heat3d_tpu.obs.metrics import percentile
-
-        buckets = {}
-        for bucket, st in sorted(self._bucket_stats.items()):
-            rec = {
-                "count": st["count"],
-                "p50_s": round(percentile(st["samples"], 50), 6),
-                "p95_s": round(percentile(st["samples"], 95), 6),
-                "max_s": round(st["max"], 6),
-            }
-            if st["clipped"]:
-                # percentiles cover the stored reservoir only, never to
-                # be mistaken for exact (count/max stay exact)
-                rec["clipped"] = True
-            buckets[bucket] = rec
-        return {
-            "buckets": buckets,
-            "depth_max": self._depth_max,
-            "batches": self._batches,
-            "delivered": self._delivered,
-            "pending": len(self._pending),
-        }
+        return self._stats.summary(pending=len(self._pending))
 
     def serve_batches(self) -> Iterator[List[ServeResult]]:
         """Pack and execute pending requests bucket by bucket, yielding
@@ -306,10 +451,9 @@ class ScenarioQueue:
         base = chunk[0].base
         members = [p.scenario for p in chunk]
         padded = _padded_size(len(members), self.max_batch, self.batch_mesh)
-        batch = self._pad_batch(base, members, padded)
+        batch = pad_batch(base, members, padded)
         solver = self._solver_for(batch, padded)
-        self._batch_hist.observe(len(chunk))
-        self._batches += 1
+        self._stats.observe_batch(len(chunk))
         bucket_s = str(batch.bucket_key())
         obs.get().event(
             "serve_batch_start",
@@ -327,75 +471,18 @@ class ScenarioQueue:
         with obs.get().span(
             "serve_batch", members=len(chunk), padded=padded
         ) as span:
-            u = solver.init_state()
-            snapshots: Optional[List[np.ndarray]] = None
-            if self.snapshot_every > 0:
-                snapshots = []
-                done = np.zeros_like(budgets)
-                while (done < budgets).any():
-                    stride = np.minimum(
-                        budgets - done, self.snapshot_every
-                    ).astype(np.int32)
-                    u = solver.run(u, stride)
-                    done = done + stride
-                    snapshots.append(solver.gather(u))
-            else:
-                u = solver.run(u, budgets)
-            # the last snapshot already gathered the final state — don't
-            # pay a second full-batch device-to-host transfer for it
-            fields = snapshots[-1] if snapshots else solver.gather(u)
-            residuals = None
-            if self.with_residuals:
-                # the residual costs one probe update per member — a
-                # health signal measured FROM the delivered state. Fields
-                # are gathered first (the probe donates u), so delivered
-                # results stay at exactly the budgeted step either way.
-                u, r2 = solver.step_with_member_residuals(u)
-                residuals = np.asarray(r2)
+            fields, residuals, snapshots = run_packed_batch(
+                solver, budgets,
+                snapshot_every=self.snapshot_every,
+                with_residuals=self.with_residuals,
+            )
             span.add(steps_total=int(budgets.sum()))
 
-        out: List[ServeResult] = []
-        now = time.monotonic()
-        for i, p in enumerate(chunk):
+        for p in chunk:
             self._pending.pop(p.request_id, None)
-            latency = now - p.submitted_at
-            # bucket-labelled: the SLO layer judges latency PER BUCKET (a
-            # big-grid bucket legitimately runs slower than a small one)
-            self._latency_hist.observe(latency, bucket=bucket_s)
-            st = self._bucket_stats.setdefault(
-                bucket_s,
-                {"count": 0, "max": 0.0, "samples": [], "clipped": False},
-            )
-            st["count"] += 1
-            st["max"] = max(st["max"], latency)
-            if len(st["samples"]) < HISTOGRAM_SAMPLE_CAP:
-                st["samples"].append(latency)
-            else:
-                st["clipped"] = True
-            self._delivered += 1
-            obs.get().event(
-                "serve_result",
-                request_id=p.request_id,
-                steps=int(budgets[i]),
-                batch_members=len(chunk),
-                queue_latency_s=round(latency, 6),
-            )
-            out.append(
-                ServeResult(
-                    request_id=p.request_id,
-                    field=fields[i],
-                    steps=int(budgets[i]),
-                    residual_sumsq=(
-                        float(residuals[i]) if residuals is not None else None
-                    ),
-                    batch_size=len(chunk),
-                    queue_latency_s=latency,
-                    snapshots=(
-                        [s[i] for s in snapshots]
-                        if snapshots is not None
-                        else None
-                    ),
-                )
-            )
-        self._depth_gauge.set(len(self._pending))
+        out = build_chunk_results(
+            [(p.request_id, p.submitted_at) for p in chunk],
+            bucket_s, budgets, fields, residuals, snapshots, self._stats,
+        )
+        self._stats.observe_depth(len(self._pending))
         return out

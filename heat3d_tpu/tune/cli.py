@@ -133,6 +133,7 @@ def cmd_run(args) -> int:
             min_win_pct=args.min_win,
             write_cache=not args.no_cache_write,
             cache_path=args.cache,
+            batch_members=args.batch_members,
         )
     except BaseException as e:
         obs.deactivate(rc=1, error=f"{type(e).__name__}: {str(e)[:200]}")
@@ -228,6 +229,11 @@ def _entry_lines(key: str, e: dict) -> str:
         # whole-face collectives here — more, smaller messages, transport
         # overlapped with the remaining compute (docs/TUNING.md)
         speed += "; partitioned-exchange winner (early-bird sub-block sends)"
+    if "|b2^" in key:
+        # batch-bucketed (ensemble-workload) winners: the serving
+        # engine's bucket solvers resolve their auto knobs here
+        # (tune run --batch-members; docs/TUNING.md)
+        speed += "; batch-bucket winner (ensemble workload)"
     fam = cfg.get("equation") or _key_equation(key)
     if fam != "heat":
         # spec-built-family winners (entry field, or the key's
@@ -281,7 +287,10 @@ def cmd_show(args) -> int:
 
 
 def _context_key(args) -> str:
-    return tcache.cache_key(_base_config(args))
+    return tcache.cache_key(
+        _base_config(args),
+        batch_size=getattr(args, "batch_members", 1) or 1,
+    )
 
 
 def cmd_apply(args) -> int:
@@ -384,6 +393,12 @@ def _add_context_args(p) -> None:
     p.add_argument("--mesh", type=int, nargs="+", default=None,
                    help="device mesh Px Py Pz (default: all devices, "
                    "balanced 3D)")
+    p.add_argument("--batch-members", type=int, default=1,
+                   help="search the B-member ENSEMBLE workload instead "
+                   "of solo: trials run serve/bench batches, single-"
+                   "tenant routes are pruned, and the winner lands at "
+                   "the b2^k batch-bucketed cache key the serving "
+                   "engine's buckets resolve through (docs/TUNING.md)")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -101,6 +101,49 @@ def _concrete_backend(cfg: SolverConfig) -> str:
     return resolved_backend_name(cfg)
 
 
+def _ensemble_incompatible(overrides: Dict[str, Any]) -> Optional[str]:
+    """Why a candidate cannot serve as a batch-bucket (ensemble) config,
+    or None. The ensemble runs the portable chain on the axis-ordered
+    ppermute exchange (serve/ensemble.py pins exactly this) — kernel
+    routes, DMA transports, pairwise ordering, and the split-step
+    overlap are single-tenant A/B knobs that would fail EnsembleSolver
+    construction; prune them with a reason instead of burning budget on
+    guaranteed status:error trials."""
+    if overrides.get("backend") in ("pallas", "conv"):
+        return f"ensemble: backend={overrides['backend']} is single-tenant"
+    if overrides.get("halo") == "dma":
+        return "ensemble: halo='dma' is single-tenant"
+    if overrides.get("halo_order") == "pairwise":
+        return "ensemble: halo_order='pairwise' is single-tenant"
+    if overrides.get("overlap"):
+        return "ensemble: overlap=True is single-tenant"
+    return None
+
+
+def _ensemble_bench(batch_members: int):
+    """A ``bench_throughput``-shaped callable measuring the candidate as
+    a B-member ensemble batch (serve/bench.bench_ensemble_throughput) —
+    the measurement behind `tune run --batch-members`: winners land at
+    the b2^k cache key the serving engine's buckets resolve through."""
+    from heat3d_tpu.serve.bench import bench_ensemble_throughput
+    from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+
+    def bench(cfg, steps, warmup, repeats):
+        members = [
+            Scenario(
+                alpha=0.3 + 0.4 * (m + 1) / batch_members, seed=m,
+                steps=steps,
+            )
+            for m in range(batch_members)
+        ]
+        return bench_ensemble_throughput(
+            ScenarioBatch(cfg, members),
+            steps=steps, warmup=warmup, repeats=repeats,
+        )
+
+    return bench
+
+
 def run_search(
     base: SolverConfig,
     space: Optional[Dict[str, Sequence[Any]]] = None,
@@ -112,11 +155,21 @@ def run_search(
     min_win_pct: float = tdecide.DEFAULT_MIN_WIN_PCT,
     write_cache: bool = True,
     cache_path: Optional[str] = None,
+    batch_members: int = 1,
 ) -> SearchResult:
     """Search the knob lattice around ``base`` and (by default) cache the
-    winner under this environment's :func:`~heat3d_tpu.tune.cache.cache_key`."""
+    winner under this environment's :func:`~heat3d_tpu.tune.cache.cache_key`.
+
+    ``batch_members`` > 1 searches the ENSEMBLE workload instead: every
+    trial measures a B-member batch through the serving engine's own
+    bench (serve/bench), ensemble-incompatible routes are pruned, and
+    the winner lands at the b2^round(log2 B) batch-bucketed cache key —
+    the entry the engine's bucket solvers resolve their auto knobs
+    through (the ROADMAP "batch buckets fall back static" debt)."""
     from heat3d_tpu.bench.harness import bench_throughput
 
+    if batch_members > 1:
+        bench_throughput = _ensemble_bench(batch_members)
     # a base carrying auto sentinels (halo='auto', time_blocking=0) would
     # otherwise be measured under the trial-time static fallback but
     # CACHED verbatim — an entry lint rejects and resolution permanently
@@ -128,11 +181,22 @@ def run_search(
     budget_left = lambda: (  # noqa: E731
         None if budget_s is None else budget_s - (time.monotonic() - t0)
     )
-    key = tcache.cache_key(base)
+    key = tcache.cache_key(base, batch_size=batch_members)
     prev_disable = os.environ.get(tcache.ENV_DISABLE)
     os.environ[tcache.ENV_DISABLE] = "1"
     try:
         candidates = tspace.enumerate_candidates(base, space)
+        if batch_members > 1:
+            candidates = [
+                (
+                    dataclasses.replace(
+                        c, prune=_ensemble_incompatible(c.overrides)
+                    )
+                    if c.prune is None
+                    else c
+                )
+                for c in candidates
+            ]
         obs.get().event(
             "tune_search_start",
             key=key,
@@ -140,6 +204,7 @@ def run_search(
             pruned=sum(1 for c in candidates if c.prune),
             budget_s=budget_s,
             steps=steps,
+            batch_members=batch_members,
         )
         trials: List[Trial] = []
         best: Optional[float] = None
@@ -233,7 +298,9 @@ def run_search(
                 elapsed_s=result.elapsed_s,
             )
             if write_cache:
-                winner_cfg = _winner_config(base, winner)
+                winner_cfg = _winner_config(
+                    base, winner, ensemble=batch_members > 1
+                )
                 # an RTT-dominated default measurement must not become the
                 # entry's speedup denominator (same exclusion that keeps
                 # it from winning)
@@ -259,10 +326,17 @@ def run_search(
             os.environ[tcache.ENV_DISABLE] = prev_disable
 
 
-def _winner_config(base: SolverConfig, winner: Trial) -> SolverConfig:
+def _winner_config(
+    base: SolverConfig, winner: Trial, ensemble: bool = False
+) -> SolverConfig:
     """The winner's SolverConfig with the backend concretized (cache
-    entries store the route that executes, not 'auto')."""
+    entries store the route that executes, not 'auto'). Ensemble
+    (batch-bucket) winners executed the parametric chain whatever the
+    solo resolver would pick — their concrete route is 'jnp' by
+    construction (serve/ensemble pins it)."""
     cfg = tspace.apply_knobs(base, winner.overrides)
+    if ensemble:
+        return dataclasses.replace(cfg, backend="jnp")
     return dataclasses.replace(cfg, backend=_concrete_backend(cfg))
 
 

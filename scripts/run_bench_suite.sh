@@ -345,6 +345,57 @@ else
   note "suite: serve smoke skipped (SKIP_SERVE_SMOKE=1)"
 fi
 
+# Async-engine smoke + AOT cold/warm A/B (informational, beside the
+# serve smoke; docs/SERVING.md "Async engine & cold start"): the same
+# tiny multi-bucket batch through the always-on engine, run TWICE
+# against one fresh session-local AOT store — the first run measures the
+# compile stall and exports the executables, the second must load them
+# back (aot.hits > 0, compile_stall_s == 0: the cold-start-elimination
+# contract, machine-checked from the two --verdict JSON lines printed to
+# the console). Also a budgeted engine-bucket `tune run --batch-members`
+# so the b2^k batch-bucket entries the engine resolves through stay
+# exercised (docs/TUNING.md). Fails SOFT; SKIP_ASYNC_SMOKE=1 skips.
+if [[ -z "${SKIP_ASYNC_SMOKE:-}" ]]; then
+  # always a suite-derived scratch path (never an operator override):
+  # the A/B needs a guaranteed-cold store, and rm -rf on a caller-
+  # supplied directory would delete a real accumulated AOT cache
+  AOT_DIR="${OUT%.jsonl}.aot_cache"
+  rm -rf "$AOT_DIR"
+  ASYNC_COLD=$(HEAT3D_AOT_CACHE="$AOT_DIR" \
+    python -m heat3d_tpu.cli serve --async --smoke --verdict \
+    2>>"$SUITE_LOG" | tail -n 1) \
+    || note "suite: async serve smoke (cold) failed (rc=$?) — informational"
+  ASYNC_WARM=$(HEAT3D_AOT_CACHE="$AOT_DIR" \
+    python -m heat3d_tpu.cli serve --async --smoke --verdict \
+    2>>"$SUITE_LOG" | tail -n 1) \
+    || note "suite: async serve smoke (warm) failed (rc=$?) — informational"
+  echo "suite: async smoke cold verdict: $ASYNC_COLD"
+  echo "suite: async smoke warm verdict: $ASYNC_WARM"
+  python - "$ASYNC_COLD" "$ASYNC_WARM" <<'PYEOF' \
+    || note "suite: AOT cold/warm A/B verdict failed — informational"
+import json, sys
+cold = json.loads(sys.argv[1])["serve_verdict"]
+warm = json.loads(sys.argv[2])["serve_verdict"]
+ca, wa = cold["engine"]["aot"], warm["engine"]["aot"]
+ok = (cold["ok"] and warm["ok"] and wa["hits"] > 0
+      and wa["compile_stall_s"] == 0)
+print(json.dumps({"aot_cold_warm_ab": {
+    "ok": ok,
+    "cold_compile_stall_s": round(ca["compile_stall_s"], 3),
+    "warm_hits": wa["hits"], "warm_load_s": round(wa["load_s"], 4),
+    "warm_compile_stall_s": wa["compile_stall_s"]}}))
+sys.exit(0 if ok else 1)
+PYEOF
+  TUNE_CACHE="${TUNE_CACHE:-${OUT%.jsonl}.tune_cache.json}"
+  python -m heat3d_tpu.cli tune run --grid "${TUNE_GRID:-16}" \
+    --batch-members 4 --steps 6 --repeats 1 --probe-steps 0 \
+    --budget-s "${TUNE_BUDGET_S:-45}" --knob time_blocking=1,2 \
+    --cache "$TUNE_CACHE" --json >> "$SUITE_LOG" 2>&1 \
+    || note "suite: engine-bucket tune smoke failed (rc=$?) — informational"
+else
+  note "suite: async serve smoke skipped (SKIP_ASYNC_SMOKE=1)"
+fi
+
 # Equation-frontend smoke (informational, beside the serve smoke): one
 # spec-built family end-to-end through the solver CLI with the fp64
 # golden check — the declarative eqn subsystem (docs/EQUATIONS.md) can't

@@ -1,0 +1,232 @@
+"""The 4-device CPU-mesh ASYNC-ENGINE acceptance battery (run by
+tests/test_serve_async.py in a subprocess with
+--xla_force_host_platform_device_count=4).
+
+Default mode (no argv) proves, on the REAL (4,1,1) spatial mesh:
+
+1. **async == drain, bitwise** — the same heterogeneous multi-bucket
+   request set through ``ScenarioQueue.drain()`` and through
+   ``AsyncServeEngine`` delivers byte-identical fields per request id
+   (7pt tb=1, 7pt tb=2, and a second grid bucket — cross-device
+   ppermutes executing, not compile-only);
+2. **submission while a batch is in flight** — the ``before_execute``
+   hook holds the first batch mid-flight while the main thread submits
+   another request; the engine must ACCEPT it (``accepted_in_flight``
+   pinned > 0) and deliver both;
+3. **per-stream submission-order buffering** — with bucket A held in
+   flight, bucket B's later-submitted request finishes first but must
+   NOT deliver before A's (one stream, submission order);
+4. **failure isolation** — a bucket whose config cannot build on this
+   host (an 8-device mesh on 4 devices) fails only its own request:
+   every other bucket's results still stream, the failure is recorded.
+
+``aot-cold DIR`` / ``aot-warm DIR`` are the warm-restart stages
+(fresh process each): cold serves with an empty AOT store (must ledger
+``aot_cache_miss`` + ``compile_stall`` + ``aot_export``, saving its
+fields), warm re-serves the same requests from the populated store
+(must ledger ``aot_cache_hit``, must NOT ledger ``compile_stall``, and
+its fields must be BITWISE-equal to the cold run's).
+"""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+
+from heat3d_tpu.core.config import (
+    BoundaryCondition,
+    GridConfig,
+    MeshConfig,
+    Precision,
+    RunConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.serve.engine import AsyncServeEngine
+from heat3d_tpu.serve.queue import ScenarioQueue
+from heat3d_tpu.serve.scenario import Scenario
+
+
+def base_cfg(grid=16, kind="7pt", tb=1, mesh=(4, 1, 1), steps=6):
+    return SolverConfig(
+        grid=GridConfig.cube(grid),
+        stencil=StencilConfig(kind=kind, bc=BoundaryCondition.DIRICHLET),
+        mesh=MeshConfig(shape=mesh),
+        precision=Precision.fp32(),
+        run=RunConfig(num_steps=steps),
+        backend="jnp",
+        halo="ppermute",
+        time_blocking=tb,
+    )
+
+
+MEMBERS = [
+    Scenario(init="hot-cube", alpha=0.3, bc_value=1.0, steps=6, seed=1),
+    Scenario(init="gaussian", alpha=0.8, bc_value=0.0, steps=5, seed=2),
+    Scenario(init="random", alpha=0.5, bc_value=-0.5, steps=4, seed=3),
+]
+
+# three buckets: 7pt tb=1, 7pt tb=2, and a second grid shape
+REQUESTS = (
+    [(base_cfg(16, tb=1), sc) for sc in MEMBERS]
+    + [(base_cfg(16, tb=2), sc) for sc in MEMBERS[:2]]
+    + [(base_cfg(12, tb=1, steps=3), MEMBERS[0])]
+)
+
+
+def check_async_equals_drain():
+    q = ScenarioQueue()
+    sync_rids = [q.submit(b, sc) for b, sc in REQUESTS]
+    sync = {r.request_id: r for r in q.drain()}
+    assert sorted(sync) == sync_rids
+
+    with AsyncServeEngine(workers=2) as eng:
+        async_rids = [eng.submit(b, sc) for b, sc in REQUESTS]
+        got = {r.request_id: r for r in eng.drain(timeout=300)}
+    assert sorted(got) == async_rids
+    for s_rid, a_rid in zip(sync_rids, async_rids):
+        np.testing.assert_array_equal(
+            got[a_rid].field, sync[s_rid].field,
+            err_msg=f"request {a_rid}: async != drain (bitwise)",
+        )
+        assert got[a_rid].steps == sync[s_rid].steps
+    print("async == drain bitwise: OK")
+
+
+def check_overlap_and_ordering():
+    hold = threading.Event()
+    first_started = threading.Event()
+    calls = []
+
+    def hook(bucket, rids):
+        calls.append((bucket, rids))
+        if len(calls) == 1:
+            first_started.set()
+            assert hold.wait(timeout=120), "test hook never released"
+
+    eng = AsyncServeEngine(workers=2, before_execute=hook)
+    # bucket A dispatches immediately and parks mid-flight in the hook
+    rid_a = eng.submit(base_cfg(16, tb=1), MEMBERS[0])
+    assert first_started.wait(timeout=120), "first batch never dispatched"
+
+    # submissions land WHILE the batch flies: same bucket (rides the
+    # next batch) and a different bucket (executes concurrently)
+    rid_a2 = eng.submit(base_cfg(16, tb=1), MEMBERS[1])
+    rid_b = eng.submit(base_cfg(12, tb=1, steps=3), MEMBERS[2])
+    assert eng.stats()["accepted_in_flight"] >= 2, eng.stats()
+
+    # bucket B is un-held: wait until its result materializes while A
+    # still flies — then assert the engine BUFFERS it (stream order)
+    deadline = 120
+    import time as _t
+
+    t0 = _t.monotonic()
+    with eng._cond:
+        while eng._req[rid_b].state != "done":
+            assert _t.monotonic() - t0 < deadline, "bucket B never finished"
+            eng._cond.wait(1.0)
+        assert eng._req[rid_a].state == "dispatched", (
+            "test premise broken: bucket A should still be in flight"
+        )
+        assert eng._pop_next() is None, (
+            "bucket B's result delivered ahead of the earlier submission "
+            "in the same stream"
+        )
+    hold.set()
+    got = [r.request_id for r in eng.drain(timeout=300)]
+    assert got == [rid_a, rid_a2, rid_b], got
+    stats = eng.stats()
+    assert stats["max_in_flight"] >= 2, stats
+    eng.shutdown()
+    print(
+        f"overlap + ordering: OK (accepted_in_flight="
+        f"{stats['accepted_in_flight']}, max_in_flight="
+        f"{stats['max_in_flight']})"
+    )
+
+
+def check_failure_isolation():
+    with AsyncServeEngine(workers=2) as eng:
+        good1 = eng.submit(base_cfg(16, tb=1), MEMBERS[0])
+        # this bucket needs 8 devices on a 4-device host: its worker
+        # fails at solver construction, AFTER dispatch
+        bad = eng.submit(base_cfg(16, tb=1, mesh=(8, 1, 1)), MEMBERS[1])
+        good2 = eng.submit(base_cfg(12, tb=1, steps=3), MEMBERS[2])
+        delivered = []
+        try:
+            for r in eng.drain(timeout=300):
+                delivered.append(r.request_id)
+            raise AssertionError("drain should re-raise the bucket failure")
+        except RuntimeError as e:
+            assert "failed" in str(e), e
+        assert sorted(delivered) == sorted([good1, good2]), delivered
+        assert [f["request_id"] for f in eng.failures] == [bad]
+        assert "devices" in eng.failures[0]["error"], eng.failures
+    print("failure isolation: OK")
+
+
+def _aot_requests():
+    return [(base_cfg(16, tb=2), sc) for sc in MEMBERS]
+
+
+def _events(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def aot_stage(mode: str, work_dir: str):
+    from heat3d_tpu import obs
+
+    ledger = os.path.join(work_dir, f"ledger-{mode}.jsonl")
+    os.environ["HEAT3D_AOT_CACHE"] = os.path.join(work_dir, "aot")
+    obs.activate(ledger, meta={"entry": f"engine_checks-{mode}"})
+    # autostart=False: dispatch only after every submission landed, so
+    # the batch composition — and therefore the AOT store's padded-size
+    # keys — is identical across the cold and warm processes
+    with AsyncServeEngine(workers=2, autostart=False) as eng:
+        rids = [eng.submit(b, sc) for b, sc in _aot_requests()]
+        got = {r.request_id: r for r in eng.drain(timeout=300)}
+    assert sorted(got) == rids
+    fields = np.stack([got[r].field for r in rids])
+    obs.deactivate(rc=0)
+    names = [e["event"] for e in _events(ledger)]
+    cold_npz = os.path.join(work_dir, "fields-cold.npy")
+    if mode == "aot-cold":
+        assert "aot_cache_miss" in names, names
+        assert "compile_stall" in names, names
+        assert "aot_export" in names, names
+        assert "aot_cache_hit" not in names, names
+        np.save(cold_npz, fields)
+        print("aot cold stage: OK (miss + compile_stall + export)")
+    else:
+        assert "aot_cache_hit" in names, names
+        # THE acceptance criterion: a warm store means the fresh process
+        # never traced or compiled the serving programs
+        assert "compile_stall" not in names, names
+        assert "aot_cache_miss" not in names, names
+        cold = np.load(cold_npz)
+        np.testing.assert_array_equal(
+            fields, cold,
+            err_msg="warm-restart results != cold run (bitwise)",
+        )
+        print("aot warm stage: OK (hit, no compile_stall, bitwise == cold)")
+
+
+def main():
+    import jax
+
+    ndev = len(jax.devices())
+    assert ndev == 4, f"need a 4-device CPU mesh, got {ndev}"
+    if len(sys.argv) > 1:
+        aot_stage(sys.argv[1], sys.argv[2])
+        print("ENGINE AOT STAGE OK")
+        return
+    check_async_equals_drain()
+    check_overlap_and_ordering()
+    check_failure_isolation()
+    print("ASYNC ENGINE EQUIVALENCE OK")
+
+
+if __name__ == "__main__":
+    main()

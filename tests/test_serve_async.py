@@ -1,0 +1,469 @@
+"""Always-on async serving engine + AOT cold-start elimination
+(heat3d_tpu/serve/engine/, heat3d_tpu/serve/aot.py; docs/SERVING.md
+"Async engine & cold start").
+
+Acceptance battery for ISSUE 14. Tiers:
+
+- in-process (1 device): backpressure under concurrent submitters,
+  cancel/shutdown semantics, AOT store round trip + staleness +
+  disabled-store behavior, the b2^k batch-bucket tune search feeding
+  the engine's auto-knob resolution, the CLI's --async/--verdict
+  wiring, and SLO-summary shape parity with the queue;
+- subprocess (REAL 4-device CPU mesh, tests/engine_checks.py): async
+  results byte-identical to synchronous drain, submission accepted
+  while a batch is in flight (test-pinned), per-stream ordering under
+  out-of-order completion, failure isolation — and the AOT
+  warm-restart round trip: a FRESH process with a warm store serves
+  bitwise-equal results with no ``compile_stall`` event at all.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from heat3d_tpu import obs
+from heat3d_tpu.core.config import (
+    GridConfig,
+    MeshConfig,
+    Precision,
+    RunConfig,
+    SolverConfig,
+    StencilConfig,
+)
+from heat3d_tpu.serve.engine import AsyncServeEngine
+from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _base(grid=8, steps=2, tb=1):
+    return SolverConfig(
+        grid=GridConfig.cube(grid),
+        stencil=StencilConfig(kind="7pt"),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        precision=Precision.fp32(),
+        run=RunConfig(num_steps=steps),
+        backend="jnp",
+        halo="ppermute",
+        time_blocking=tb,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _isolated_stores(tmp_path, monkeypatch):
+    """Every test gets its own AOT store and tune cache — a developer's
+    ~/.cache must never leak into (or be polluted by) the suite."""
+    monkeypatch.setenv("HEAT3D_AOT_CACHE", str(tmp_path / "aot"))
+    monkeypatch.setenv("HEAT3D_TUNE_CACHE", str(tmp_path / "tune.json"))
+    yield
+
+
+# ---- engine semantics (single device) --------------------------------------
+
+
+def test_backpressure_under_concurrent_submitters():
+    """The HEAT3D_SERVE_QUEUE contract under concurrency: with the one
+    bucket worker held mid-flight, outstanding requests accumulate and
+    submits past the cap raise — from whichever thread sent them — while
+    every ACCEPTED request still delivers after release."""
+    hold = threading.Event()
+    started = threading.Event()
+
+    def hook(bucket, rids):
+        started.set()
+        assert hold.wait(timeout=60)
+
+    eng = AsyncServeEngine(
+        max_depth=3, workers=1, before_execute=hook, aot=False
+    )
+    base = _base()
+    eng.submit(base, Scenario(alpha=0.5, seed=0))
+    assert started.wait(timeout=60)
+
+    accepted, rejected = [], []
+    lock = threading.Lock()
+
+    def submitter(k):
+        for i in range(3):
+            try:
+                rid = eng.submit(base, Scenario(alpha=0.4, seed=10 * k + i))
+                with lock:
+                    accepted.append(rid)
+            except RuntimeError as e:
+                assert "queue full" in str(e)
+                with lock:
+                    rejected.append((k, i))
+
+    threads = [
+        threading.Thread(target=submitter, args=(k,)) for k in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 1 in flight + at most 2 more fit under max_depth=3
+    assert len(accepted) == 2, (accepted, rejected)
+    assert len(rejected) == 10
+    hold.set()
+    got = [r.request_id for r in eng.drain(timeout=120)]
+    assert sorted(got) == sorted([0] + accepted)
+    eng.shutdown()
+
+
+def test_cancel_pending_and_shutdown_refuses_submissions():
+    hold = threading.Event()
+    started = threading.Event()
+
+    def hook(bucket, rids):
+        started.set()
+        assert hold.wait(timeout=60)
+
+    eng = AsyncServeEngine(workers=1, before_execute=hook, aot=False)
+    base = _base()
+    rid1 = eng.submit(base, Scenario(alpha=0.5, seed=0))
+    assert started.wait(timeout=60)
+    rid2 = eng.submit(base, Scenario(alpha=0.4, seed=1))  # bucket busy
+    assert eng.cancel(rid2) is True
+    assert eng.cancel(rid1) is False  # in flight: results are coming
+    assert eng.cancel(99) is False
+    hold.set()
+    got = [r.request_id for r in eng.drain(timeout=120)]
+    assert got == [rid1]
+    stats = eng.stats()
+    assert stats["cancelled"] == 1 and stats["delivered"] == 1
+    eng.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        eng.submit(base, Scenario(alpha=0.5))
+    eng.shutdown()  # idempotent
+
+
+def test_engine_summary_matches_queue_shape_for_slo(tmp_path):
+    """The SLO layer judges the engine unchanged: the live summary has
+    the queue's exact shape and evaluates through obs.perf.slo."""
+    from heat3d_tpu.obs.perf import slo as slo_mod
+    from heat3d_tpu.serve.queue import ScenarioQueue
+
+    base = _base()
+    q = ScenarioQueue()
+    q.submit(base, Scenario(alpha=0.5, seed=0))
+    list(q.drain())
+    with AsyncServeEngine(workers=1, aot=False) as eng:
+        eng.submit(base, Scenario(alpha=0.5, seed=0))
+        list(eng.drain(timeout=120))
+        summary = eng.metrics_summary()
+    assert set(summary) == set(q.metrics_summary())
+    assert summary["delivered"] == 1 and summary["batches"] == 1
+    (bucket_rec,) = summary["buckets"].values()
+    assert {"count", "p50_s", "p95_s", "max_s"} <= set(bucket_rec)
+    spec = slo_mod.load_spec(None)  # built-in default objectives
+    report = slo_mod.evaluate(
+        [], spec, serve_summary={**summary, "source": "live engine"}
+    )
+    assert report["verdict"] in ("pass", "warn")
+    assert any(
+        o["kind"] == "serve_latency" and o["status"] != "no_data"
+        for o in report["objectives"]
+    )
+
+
+# ---- AOT cache (serve/aot.py) ----------------------------------------------
+
+
+def _solver(tb=1, steps=3):
+    batch = ScenarioBatch(
+        _base(steps=steps, tb=tb),
+        [Scenario(alpha=0.5, bc_value=1.0, seed=0),
+         Scenario(init="gaussian", alpha=0.8, seed=1)],
+    )
+    from heat3d_tpu.serve.ensemble import EnsembleSolver
+
+    return EnsembleSolver(batch, bind="traced")
+
+
+def _event_names(path):
+    return [json.loads(line)["event"] for line in open(path)]
+
+
+def test_aot_roundtrip_in_process(tmp_path):
+    """Export then load within one process: the second solver adopts the
+    deserialized executables (hit, no second compile_stall) and computes
+    the identical bits."""
+    from heat3d_tpu.serve import aot
+
+    ledger = tmp_path / "ledger.jsonl"
+    obs.activate(str(ledger), meta={"entry": "test"})
+    try:
+        s1 = _solver()
+        r1 = aot.warm(s1)
+        assert r1["outcome"] == "miss" and r1["source"] == "compiled"
+        assert r1["exported"] is True and r1["compile_stall_s"] > 0
+        u1 = s1.init_state()
+        f1 = s1.gather(s1.run(u1))
+
+        s2 = _solver()
+        r2 = aot.warm(s2)
+        assert r2["outcome"] == "hit" and r2["source"] == "aot"
+        assert r2["load_s"] is not None
+        u2 = s2.init_state()
+        f2 = s2.gather(s2.run(u2))
+        np.testing.assert_array_equal(f1, f2)
+
+        # rebind survives adoption (the engine's bucket-reuse path)
+        s2.batch = ScenarioBatch(
+            _base(steps=3),
+            [Scenario(alpha=0.4, seed=5), Scenario(alpha=0.6, seed=6)],
+        )
+        s2._build_coefficients()
+        s2.gather(s2.run(s2.init_state()))  # executes, no retrace
+    finally:
+        obs.deactivate(rc=0)
+    names = _event_names(ledger)
+    assert names.count("compile_stall") == 1
+    assert names.count("aot_export") == 1
+    assert names.count("aot_cache_hit") == 1
+
+
+def test_aot_stale_on_toolchain_drift(tmp_path):
+    """A manifest from another stack (jax version drift) is stale: the
+    warm-up recompiles and REWRITES the entry instead of loading it."""
+    from heat3d_tpu.serve import aot
+
+    ledger = tmp_path / "ledger.jsonl"
+    obs.activate(str(ledger), meta={"entry": "test"})
+    try:
+        s1 = _solver()
+        aot.warm(s1)
+        key = aot.aot_key(s1)
+        mpath = os.path.join(aot.aot_dir(), f"{key}.json")
+        manifest = json.load(open(mpath))
+        manifest["provenance"]["jax_version"] = "0.0.1-other"
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+
+        s2 = _solver()
+        r2 = aot.warm(s2)
+        assert r2["outcome"] == "stale" and r2["source"] == "compiled"
+        fresh = json.load(open(mpath))
+        assert fresh["provenance"]["jax_version"] != "0.0.1-other"
+    finally:
+        obs.deactivate(rc=0)
+    names = _event_names(ledger)
+    assert "aot_cache_stale" in names
+    assert names.count("compile_stall") == 2
+
+
+def test_aot_disabled_env_measures_but_persists_nothing(
+    tmp_path, monkeypatch
+):
+    from heat3d_tpu.serve import aot
+
+    monkeypatch.setenv("HEAT3D_AOT_CACHE", "0")
+    assert aot.aot_dir() is None
+    ledger = tmp_path / "ledger.jsonl"
+    obs.activate(str(ledger), meta={"entry": "test"})
+    try:
+        r = aot.warm(_solver())
+        assert r["outcome"] == "disabled"
+        assert r["compile_stall_s"] > 0
+    finally:
+        obs.deactivate(rc=0)
+    names = _event_names(ledger)
+    # the stall is still a measured ledger quantity; nothing stored
+    assert "compile_stall" in names
+    assert "aot_export" not in names and "aot_cache_miss" not in names
+
+
+def test_aot_key_separates_buckets_and_batch_shapes():
+    from heat3d_tpu.serve import aot
+
+    a = aot.aot_key(_solver(tb=1))
+    assert a == aot.aot_key(_solver(tb=1))  # deterministic
+    assert a != aot.aot_key(_solver(tb=2))  # structural drift re-keys
+    batch3 = ScenarioBatch(
+        _base(steps=3),
+        [Scenario(alpha=0.5, seed=0), Scenario(alpha=0.6, seed=1),
+         Scenario(alpha=0.7, seed=2)],
+    )
+    from heat3d_tpu.serve.ensemble import EnsembleSolver
+
+    assert a != aot.aot_key(EnsembleSolver(batch3, bind="traced"))
+    # the exchange-plan leg: halo_plan is NOT in solver_bucket_key but
+    # changes the traced ppermute schedule — it must re-key (a tuned
+    # partitioned winner can never warm-hit a monolithic executable)
+    import dataclasses
+
+    part = dataclasses.replace(_base(steps=3), halo_plan="partitioned")
+    es_part = EnsembleSolver(
+        ScenarioBatch(
+            part,
+            [Scenario(alpha=0.5, seed=0), Scenario(alpha=0.6, seed=1)],
+        ),
+        bind="traced",
+    )
+    assert a != aot.aot_key(es_part)
+
+
+# ---- per-bucket tuned winners (the ROADMAP static-fallback debt) -----------
+
+
+def test_tune_run_batch_members_lands_bucketed_entry_and_engine_resolves(
+    tmp_path,
+):
+    """`tune run --batch-members B` writes the winner at the b2^k key,
+    pruning single-tenant routes; an EnsembleSolver (the engine's bucket
+    build) with auto knobs then resolves THROUGH that entry instead of
+    falling back static."""
+    from heat3d_tpu.tune import cache as tcache
+    from heat3d_tpu.tune import measure as tmeasure
+
+    base = _base(grid=8, steps=2)
+    result = tmeasure.run_search(
+        base,
+        space={"time_blocking": (1, 2), "halo_order": ("axis", "pairwise")},
+        steps=2,
+        repeats=1,
+        probe_steps=0,
+        batch_members=2,
+    )
+    assert "|b2^1" in result.key
+    pruned = {
+        t.reason for t in result.trials if t.status == "pruned" and t.reason
+    }
+    assert any("single-tenant" in r for r in pruned), pruned
+    assert result.winner is not None and result.cache_written
+    entry = tcache.load()["entries"][result.key]
+    assert entry["config"]["backend"] == "jnp"  # the ensemble's route
+
+    # force a deterministic winner so the resolution assert is exact
+    import dataclasses
+
+    winner_cfg = dataclasses.replace(
+        base, time_blocking=2, backend="jnp"
+    )
+    tcache.store_entry(result.key, winner_cfg, 1.0)
+
+    from heat3d_tpu.serve.ensemble import EnsembleSolver
+
+    auto_base = dataclasses.replace(base, time_blocking=0)
+    es = EnsembleSolver(
+        ScenarioBatch(
+            auto_base,
+            [Scenario(alpha=0.5, seed=0), Scenario(alpha=0.6, seed=1)],
+        ),
+        bind="traced",
+    )
+    assert es.cfg.time_blocking == 2  # resolved via the b2^1 entry
+    # and the solo key is untouched: a solo auto run still falls static
+    assert tcache.cache_key(base) not in tcache.load()["entries"]
+
+
+# ---- CLI --------------------------------------------------------------------
+
+
+def test_serve_cli_async_smoke_verdict_and_results(capsys):
+    from heat3d_tpu.serve.cli import main as serve_main
+
+    rc = serve_main(["--async", "--smoke", "--verdict"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    verdict = json.loads(out[-1])["serve_verdict"]
+    assert verdict["ok"] and verdict["delivered"] == verdict["requests"] == 3
+    eng = verdict["engine"]
+    assert eng["batches"] >= 2 and eng["failed"] == 0
+    assert eng["aot"]["misses"] + eng["aot"]["hits"] >= 1
+    results = [json.loads(line) for line in out[:-1]]
+    assert [r["request_id"] for r in results] == [0, 1, 2]
+
+
+def test_serve_cli_async_matches_sync_results(capsys):
+    """--async --smoke streams the same per-request numbers as the
+    synchronous smoke (the CLI-level mirror of the bitwise battery)."""
+    from heat3d_tpu.serve.cli import main as serve_main
+
+    assert serve_main(["--smoke"]) == 0
+    sync_lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert serve_main(["--async", "--smoke"]) == 0
+    async_lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    for s, a in zip(sync_lines, async_lines):
+        assert s["request_id"] == a["request_id"]
+        assert s["field_mean"] == a["field_mean"]
+        assert s["field_max"] == a["field_max"]
+        assert s["steps"] == a["steps"]
+
+
+# ---- the 4-device CPU-mesh acceptance --------------------------------------
+
+
+def _subproc_env(tmp_path=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE), env.get("PYTHONPATH", "")]
+    )
+    if tmp_path is not None:
+        env["HEAT3D_AOT_CACHE"] = str(tmp_path / "aot")
+    else:
+        env["HEAT3D_AOT_CACHE"] = "0"
+    return env
+
+
+def test_async_engine_equivalence_on_cpu_mesh_tier1():
+    """THE acceptance proof (ISSUE 14): on a REAL 4-device CPU mesh the
+    async engine delivers byte-identical results to the synchronous
+    drain across heterogeneous multi-bucket requests, accepts a
+    submission while a batch is in flight (test-pinned), buffers
+    out-of-order completions for per-stream submission order, and
+    isolates a failed bucket."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "engine_checks.py")],
+        env=_subproc_env(),
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"async engine battery failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    assert "ASYNC ENGINE EQUIVALENCE OK" in proc.stdout
+
+
+def test_aot_warm_restart_round_trip_on_cpu_mesh_tier1(tmp_path):
+    """Cold-start elimination, end to end: process 1 serves with an
+    empty AOT store (compile_stall measured + exported), process 2 — a
+    genuinely fresh interpreter — serves the same requests from the
+    warm store with NO compile_stall event and bitwise-equal fields."""
+    for stage in ("aot-cold", "aot-warm"):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(HERE, "engine_checks.py"),
+                stage,
+                str(tmp_path),
+            ],
+            env=_subproc_env(tmp_path),
+            capture_output=True,
+            text=True,
+            timeout=420,
+        )
+        assert proc.returncode == 0, (
+            f"{stage} failed\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr}"
+        )
+        assert "ENGINE AOT STAGE OK" in proc.stdout
