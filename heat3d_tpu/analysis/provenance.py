@@ -114,6 +114,30 @@ def check_row(r: dict) -> list:
                 "missing 'halo_plan' (exchange-plan provenance — a "
                 "partitioned p50 must not masquerade as monolithic)"
             )
+    elif r.get("bench") == "weak_scaling":
+        # weak-scaling harness rows (scripts/weak_scaling.py): the rung's
+        # mesh, per-chip rate, and its post-heal status must be provable
+        # from the row alone — a degraded rung's throughput unlabeled
+        # would pollute the ≥90%-weak-scaling record
+        if "platform" not in r:
+            problems.append("missing 'platform'")
+        if not isinstance(r.get("gcell_per_sec_per_chip"), (int, float)):
+            problems.append(
+                "gcell_per_sec_per_chip missing/non-numeric (the judged "
+                "weak-scaling metric)"
+            )
+        if "post_heal" not in r or not isinstance(r["post_heal"], bool):
+            problems.append(
+                "post_heal missing/non-bool (elastic provenance — a rung "
+                "measured after a re-factorization must say so)"
+            )
+        if r.get("post_heal") and not isinstance(
+            r.get("recovery_s"), (int, float)
+        ):
+            problems.append(
+                "recovery_s missing/non-numeric on a post_heal row (the "
+                "chaos harness's judged recovery time)"
+            )
     if r.get("bench") in ("throughput", "halo") and not isinstance(
         r.get("sync_rtt_s"), (int, float)
     ):
@@ -121,6 +145,20 @@ def check_row(r: dict) -> list:
             "sync_rtt_s missing/non-numeric (RTT-dominated samples not "
             "auditable from the row)"
         )
+    # elastic provenance (any row kind): a row measured after a
+    # survivor-mesh re-factorization must carry the mesh it actually ran
+    # on — degraded throughput can never pollute baselines unlabeled
+    if r.get("post_heal"):
+        ms = r.get("mesh_shape")
+        if not (
+            isinstance(ms, list)
+            and len(ms) == 3
+            and all(isinstance(x, int) and x >= 1 for x in ms)
+        ):
+            problems.append(
+                "mesh_shape missing/invalid on a post_heal row (the "
+                "degraded mesh the rate was measured on)"
+            )
     return problems
 
 
@@ -147,6 +185,7 @@ def check_file(path: str, start_line: int = 1) -> list:
             if not isinstance(r, dict) or r.get("bench") not in (
                 "throughput",
                 "halo",
+                "weak_scaling",
             ):
                 continue  # foreign lines (headline records, notes) pass
             for p in check_row(r):

@@ -71,6 +71,20 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                  "desc": "survived failure: kind_, resumed_from"},
     "generation_save": {"kind": "point", "module": "resilience/supervisor.py",
                         "desc": "checkpoint generation written"},
+    # elastic degradation (resilience/elastic.py + supervisor)
+    "elastic_refactor": {"kind": "point", "module": "resilience/elastic.py",
+                         "desc": "survivor-mesh re-factorization: "
+                                 "direction (degrade|expand), old/new "
+                                 "mesh, survivors, re-stitch seconds"},
+    "degraded_mode_enter": {"kind": "point",
+                            "module": "resilience/supervisor.py",
+                            "desc": "supervised run continuing on a "
+                                    "survivor mesh (step, mesh, "
+                                    "survivors)"},
+    "degraded_mode_exit": {"kind": "point",
+                           "module": "resilience/supervisor.py",
+                           "desc": "re-expand restored the original "
+                                   "mesh (step, degraded seconds)"},
     "backend_probe": {"kind": "span", "module": "utils/backendprobe.py",
                       "desc": "out-of-process backend liveness probe"},
     # checkpoints
@@ -175,6 +189,12 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                        "desc": "dispatcher handed a packed chunk to a "
                                "bucket worker (request ids, in-flight "
                                "count at dispatch)"},
+    "serve_requeue": {"kind": "point", "module": "serve/engine/core.py",
+                      "desc": "backend-loss batch requeued with backoff "
+                              "instead of failed (bucket, request ids, "
+                              "attempt, backoff seconds) — opens the "
+                              "degraded window the SLO serve_degraded "
+                              "objective budgets"},
     "serve_batch_ready": {"kind": "point", "module": "serve/engine/core.py",
                           "desc": "a batch's device futures resolved in "
                                   "its worker (execute seconds; the "
@@ -270,6 +290,16 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
                       "desc": "deterministic fault-injection plan"},
     "HEAT3D_FAULT_STATE": {"module": "resilience/faults.py",
                            "desc": "fault-injection state file (fire-once)"},
+    "HEAT3D_HEAL_MODE": {"module": "resilience/elastic.py",
+                         "desc": "supervised heal mode default "
+                                 "(wait|elastic|auto; --heal-mode "
+                                 "overrides — docs/RESILIENCE.md "
+                                 "\"Elastic degradation\")"},
+    "HEAT3D_HEAL_DEADLINE_S": {"module": "resilience/elastic.py",
+                               "desc": "heal-wait total deadline seconds "
+                                       "(default 1800); in auto heal "
+                                       "mode its expiry triggers the "
+                                       "elastic fallback"},
     "HEAT3D_TUNE_CACHE": {"module": "tune/cache.py",
                           "desc": "tuning-cache store path"},
     "HEAT3D_TUNE_DISABLE": {"module": "tune/cache.py",

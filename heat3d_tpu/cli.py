@@ -159,6 +159,26 @@ def build_parser() -> argparse.ArgumentParser:
         "--max-recoveries", type=int, default=3,
         help="(with --supervise) give up after this many survived failures",
     )
+    p.add_argument(
+        "--heal-mode", choices=["wait", "elastic", "auto"], default=None,
+        help="(with --supervise) what a confirmed backend loss means: "
+        "'wait' = probe until the ORIGINAL backend returns (PR 1 "
+        "behavior; the HEAT3D_HEAL_DEADLINE_S deadline re-raises), "
+        "'elastic' = probe the DEVICE SET and re-plan the moment any "
+        "survivors answer (never waits out a platform heal): "
+        "re-factorize the mesh over the survivors, re-stitch the newest "
+        "generation onto the degraded mesh, continue "
+        "(docs/RESILIENCE.md \"Elastic degradation\"), 'auto' = wait "
+        "first, degrade when the heal deadline expires or the backend "
+        "heals smaller. Default $HEAT3D_HEAL_MODE, else wait",
+    )
+    p.add_argument(
+        "--reexpand", action="store_true",
+        help="(with --supervise --heal-mode elastic|auto) opt-in "
+        "re-expand: while degraded, probe at each checkpoint boundary "
+        "and re-factorize back onto the original mesh when full "
+        "capacity returns (degraded_mode_exit ledger event)",
+    )
     p.add_argument("--profile", "--profile-dir", dest="profile_dir",
                    default=None, metavar="DIR",
                    help="capture a jax.profiler trace (TensorBoard/"
@@ -310,6 +330,13 @@ def _main(argv: Optional[List[str]] = None) -> int:
     from heat3d_tpu.tune.cache import resolve_config
 
     cfg = resolve_config(cfg)
+    if args.supervise:
+        # resolve the env default NOW so run_start records the heal mode
+        # that will actually govern (the same rule as the auto knobs
+        # above); a bad HEAT3D_HEAL_MODE fails here, in ms, not mid-outage
+        from heat3d_tpu.resilience.elastic import resolve_heal_mode
+
+        args.heal_mode = resolve_heal_mode(args.heal_mode)
     ledger.event(
         "run_start",
         grid=list(cfg.grid.shape),
@@ -325,6 +352,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
         time_blocking=cfg.time_blocking,
         steps=cfg.run.num_steps,
         supervise=bool(args.supervise),
+        heal_mode=args.heal_mode,
     )
 
     dump_slice = None
@@ -591,6 +619,8 @@ def _main_supervised(args, cfg, solver, dump_slice) -> int:
             watchdog_s=args.watchdog,
             max_recoveries=args.max_recoveries,
             init=args.init,
+            heal_mode=args.heal_mode,
+            reexpand=args.reexpand,
             # the platform this run STARTED on: without it, a probe child
             # whose jax silently falls back to CPU would classify a real
             # TPU outage as "backend alive" (re-raise instead of recover)
@@ -619,9 +649,15 @@ def _main_supervised(args, cfg, solver, dump_slice) -> int:
     )
     # report through the solver that PRODUCED u: a recovery may have
     # rebuilt it (cross-mesh heal), and gather/slice on the stale
-    # instance would bind the dead mesh
+    # instance would bind the dead mesh. Same rule for the CONFIG: an
+    # elastic re-factorization changed the mesh, and the summary's
+    # mesh/provenance must describe the run that finished, not the one
+    # that was requested (degraded throughput labeled at the source —
+    # the supervised record carries degraded/mesh_shape/refactors too)
+    final_solver = result.solver or solver
+    final_cfg = getattr(final_solver, "cfg", cfg)
     return _finish(
-        args, cfg, result.solver or solver, result.u, busy,
+        args, final_cfg, final_solver, result.u, busy,
         result.steps_done, result.start_step, result.residual, dump_slice,
         extra_summary={"supervised": supervised_record},
     )

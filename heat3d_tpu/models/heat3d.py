@@ -367,6 +367,12 @@ class HeatSolver3D:
         from heat3d_tpu.resilience.supervisor import run_supervised
 
         kwargs.setdefault("make_solver", lambda: HeatSolver3D(self.cfg))
+        # the elastic path (heal_mode='elastic'|'auto') needs a
+        # config-parameterized factory: a survivor-mesh re-factorization
+        # rebuilds the solver on the DEGRADED config, not this one
+        # (resilience/elastic.py; docs/RESILIENCE.md)
+        kwargs.setdefault("make_solver_for", lambda cfg: HeatSolver3D(cfg))
+        kwargs.setdefault("base_cfg", self.cfg)
         return run_supervised(
             self, total_steps, ckpt_root, checkpoint_every, **kwargs
         )

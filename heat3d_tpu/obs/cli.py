@@ -44,6 +44,10 @@ NOTABLE = (
     "ckpt_corrupt",
     "ckpt_quarantine",
     "recovery",
+    "elastic_refactor",
+    "degraded_mode_enter",
+    "degraded_mode_exit",
+    "serve_requeue",
     "resume",
     "run_summary",
     "metrics_summary",
@@ -278,6 +282,47 @@ def ensemble_lines(events: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def elastic_lines(events: List[Dict[str, Any]]) -> List[str]:
+    """The elastic-degradation section of a run summary: each
+    ``elastic_refactor`` (old mesh -> new mesh, re-stitch seconds) and
+    the degraded windows (enter/exit pairs; an unclosed window is an
+    honest ``still degraded``). Fails soft to [] like every summary
+    section."""
+    lines: List[str] = []
+    try:
+        for r in events:
+            if r.get("event") == "elastic_refactor":
+                lines.append(
+                    f"   elastic {r.get('direction', 'degrade')}: "
+                    f"mesh {r.get('old_mesh')} -> {r.get('new_mesh')} "
+                    f"({r.get('survivors')} survivor(s), re-stitch "
+                    f"{_fmt_s(r.get('restitch_s'))}) at step "
+                    f"{r.get('step')}"
+                )
+            elif r.get("event") == "degraded_mode_enter":
+                lines.append(
+                    f"   degraded mode ENTER at step {r.get('step')} "
+                    f"(mesh {r.get('mesh')})"
+                )
+            elif r.get("event") == "degraded_mode_exit":
+                lines.append(
+                    f"   degraded mode EXIT at step {r.get('step')} "
+                    f"after {_fmt_s(r.get('degraded_s'))} "
+                    f"(mesh {r.get('mesh')} restored)"
+                )
+        enters = sum(
+            1 for r in events if r.get("event") == "degraded_mode_enter"
+        )
+        exits = sum(
+            1 for r in events if r.get("event") == "degraded_mode_exit"
+        )
+        if enters > exits:
+            lines.append("   degraded mode: still degraded at ledger end")
+    except Exception:  # noqa: BLE001 - a summary section must not kill summary
+        return []
+    return lines
+
+
 def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
     out = out or sys.stdout
     head = events[0]
@@ -331,6 +376,12 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
             file=out,
         )
 
+    # elastic-degradation section (docs/RESILIENCE.md): one line per
+    # survivor-mesh re-factorization + the degraded windows, so an outage
+    # that a run survived degraded is attributable at a glance
+    for line in elastic_lines(events):
+        print(line, file=out)
+
     # roofline section: cost-analysis telemetry joined with measured time
     for line in roofline_lines(events):
         print(line, file=out)
@@ -374,6 +425,9 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
                 "batch_members", "queue_latency_s",
                 "verdict", "depth_max", "delivered", "batches",
                 "span", "delta_pct", "events", "streams",
+                "direction", "old_mesh", "new_mesh", "survivors",
+                "restitch_s", "mesh", "degraded_s", "bucket", "attempt",
+                "backoff_s",
             )
             if k in r
         ]

@@ -40,6 +40,13 @@ Three objective kinds, three sources:
   exchange, from a ``--profile`` capture's per-phase totals
   (``obs.perf.timeline``); without a capture the objective reports
   ``no_data`` rather than guessing from wall-clock.
+- ``serve_degraded`` — cumulative seconds the serving tier spent in
+  degraded mode (backend-loss requeues open the window, the next
+  successful batch closes it; docs/SERVING.md "Degraded-mode
+  serving"), judged against a ``max_s`` degraded-time budget. Source
+  is the same ``serve_metrics_summary`` (``degraded_s`` field —
+  always present on summaries new enough to carry the feature;
+  pre-elastic ledgers report ``no_data``, never a vacuous pass).
 
 **Burn rate** = measured / objective. ``breach`` above 1.0, ``warn`` at
 or above ``warn_ratio`` (spec field; ``HEAT3D_SLO_WARN_RATIO``
@@ -61,7 +68,7 @@ ENV_SLO_SPEC = "HEAT3D_SLO_SPEC"
 ENV_SLO_WARN_RATIO = "HEAT3D_SLO_WARN_RATIO"
 DEFAULT_WARN_RATIO = 0.9
 
-KINDS = ("serve_latency", "step_time", "halo_share")
+KINDS = ("serve_latency", "step_time", "halo_share", "serve_degraded")
 
 # The spec used when none is configured: ceilings generous enough that
 # only a genuinely wedged run breaches them — so the CI smoke exercises
@@ -111,7 +118,9 @@ def load_spec(path: Optional[str] = None) -> Dict[str, Any]:
                 f"{path}: objective #{i} ({o.get('name', kind)}) needs a "
                 f"positive {target_key}"
             )
-        if kind != "halo_share" and o.get("percentile") not in (50, 95):
+        if kind in ("serve_latency", "step_time") and o.get(
+            "percentile"
+        ) not in (50, 95):
             raise ValueError(
                 f"{path}: objective #{i} percentile must be 50 or 95 "
                 "(the percentiles the metrics layer records)"
@@ -157,6 +166,11 @@ def serve_summary_from_events(
         return {
             "buckets": last["buckets"],
             "depth_max": last.get("depth_max"),
+            # degraded-mode provenance (absent on pre-elastic ledgers —
+            # the serve_degraded objective then reads no_data)
+            "degraded": last.get("degraded"),
+            "degraded_s": last.get("degraded_s"),
+            "requeues": last.get("requeues"),
             "source": "serve_metrics_summary",
         }
     lat = [
@@ -244,6 +258,17 @@ def evaluate(
             if step_samples:
                 value = float(percentile(step_samples, o["percentile"]))
                 rec["samples"] = len(step_samples)
+            burn = None if value is None else value / rec["target_s"]
+        elif kind == "serve_degraded":
+            rec["target_s"] = float(o["max_s"])
+            ds = (serve_summary or {}).get("degraded_s")
+            if isinstance(ds, (int, float)):
+                value = float(ds)
+                if (serve_summary or {}).get("degraded"):
+                    rec["still_degraded"] = True
+                rq = (serve_summary or {}).get("requeues")
+                if isinstance(rq, int):
+                    rec["requeues"] = rq
             burn = None if value is None else value / rec["target_s"]
         else:  # halo_share
             rec["target_frac"] = float(o["max_frac"])

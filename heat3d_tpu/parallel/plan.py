@@ -402,6 +402,20 @@ def clear_plan_cache() -> None:
     _PLAN_CACHE.clear()
 
 
+def drop_plans_for_mesh(mesh_shape) -> int:
+    """Forget every cached plan keyed to ``mesh_shape`` — the elastic
+    re-factorization hook (resilience/elastic.py): after a survivor-mesh
+    re-plan the dead mesh's precomputed permutations can never be
+    exchanged again in this process, and the survivor mesh builds fresh
+    plans (audited by their own ``exchange_plan_built`` events). Returns
+    how many plans were dropped."""
+    shape = tuple(mesh_shape)
+    gone = [k for k in _PLAN_CACHE if k[0] == shape]
+    for k in gone:
+        del _PLAN_CACHE[k]
+    return len(gone)
+
+
 def resolve_halo_plan(cfg: SolverConfig) -> str:
     """The concrete plan mode for ``cfg``: the tuning cache resolves
     ``'auto'`` at the entry points (tune.cache.resolve_config); any

@@ -1,0 +1,231 @@
+"""Elastic degradation: survivor-mesh re-factorization for supervised runs.
+
+PR 1's supervisor treats every backend loss as a *wait state*: probe
+until the original backend returns, then resume on the original mesh.
+That is the right posture for a transient tunnel outage — and the wrong
+one for real hardware churn, where a chip or host is gone for hours and
+the production answer is to keep serving on the survivors (the
+Exascale-framework / GPU-aware-async-tasks papers' recovery-without-
+restart thesis; ROADMAP "elastic weak-scaling to pod scale"). This
+module makes loss a *re-plan event*:
+
+- **heal_mode** (``HEAT3D_HEAL_MODE`` / ``--heal-mode``):
+  ``wait`` (the PR 1 behavior, default), ``elastic`` (on a confirmed
+  loss, re-probe the device set and re-factorize over survivors), or
+  ``auto`` (heal-wait first; the heal DEADLINE — not an operator —
+  triggers the elastic fallback).
+- **Survivor meshes are certified, not improvised**:
+  :func:`survivor_config` reuses the tuner's mesh factorization
+  candidates (:func:`heat3d_tpu.tune.space.mesh_candidates`) and the
+  production validation (``SolverConfig.__post_init__`` +
+  ``prune_reason`` building the real solver), plus the re-stitch
+  contract — the degraded config must keep the checkpoint's storage
+  shape (``padded_shape``) so the ``gen-<step>`` shards stitch onto the
+  new mesh through the existing cross-mesh path in
+  ``utils/checkpoint.py``.
+- **The re-stitch is the existing path**: :func:`refactor_and_restitch`
+  rebuilds the solver for the survivor config, loads the newest good
+  generation (block-stitching shards saved on the dead mesh), drops the
+  dead mesh's cached :class:`~heat3d_tpu.parallel.plan.ExchangePlan`\\ s
+  and pre-builds the survivor mesh's, and emits one ``elastic_refactor``
+  ledger event (old/new mesh, survivor count, re-stitch seconds) so
+  ``heat3d obs timeline`` can attribute the outage end to end.
+- **Deadline knob**: ``HEAT3D_HEAL_DEADLINE_S`` caps the heal wait
+  (:func:`default_heal_policy`); in ``auto`` mode its expiry is what
+  flips the run from waiting to degrading.
+
+The supervisor (``resilience/supervisor.py``) owns the loop state —
+``degraded_mode_enter`` / ``degraded_mode_exit`` events, the opt-in
+re-expand when capacity returns — and the serving tier's analogue
+(requeue-with-backoff + the ``degraded`` ServeStats flag) lives in
+``serve/engine/core.py``. docs/RESILIENCE.md "Elastic degradation" is
+the operator contract.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from heat3d_tpu import obs
+from heat3d_tpu.resilience.retry import RetryPolicy
+from heat3d_tpu.utils.logging import get_logger
+
+log = get_logger("heat3d.elastic")
+
+ENV_HEAL_MODE = "HEAT3D_HEAL_MODE"
+ENV_HEAL_DEADLINE = "HEAT3D_HEAL_DEADLINE_S"
+HEAL_MODES = ("wait", "elastic", "auto")
+
+# the PR 1 heal-wait deadline, now the overridable default
+DEFAULT_HEAL_DEADLINE_S = 1800.0
+
+
+def resolve_heal_mode(mode: Optional[str] = None) -> str:
+    """The concrete heal mode: explicit argument > ``HEAT3D_HEAL_MODE``
+    env > ``wait`` (the PR 1 behavior). Raises on unknown values — a
+    typo'd mode silently heal-waiting forever is the exact failure this
+    knob exists to end."""
+    mode = mode or os.environ.get(ENV_HEAL_MODE) or "wait"
+    if mode not in HEAL_MODES:
+        raise ValueError(
+            f"unknown heal_mode {mode!r} (want one of {HEAL_MODES}; "
+            f"{ENV_HEAL_MODE} is the env default)"
+        )
+    return mode
+
+
+def heal_deadline_s(default: float = DEFAULT_HEAL_DEADLINE_S) -> float:
+    """The heal-wait total deadline: ``HEAT3D_HEAL_DEADLINE_S`` override,
+    else ``default``. A non-numeric override falls back (the knob must
+    never kill the recovery it bounds)."""
+    raw = os.environ.get(ENV_HEAL_DEADLINE)
+    if raw:
+        try:
+            v = float(raw)
+            if v > 0:
+                return v
+            log.warning(
+                "%s=%r is not positive; using %.0fs",
+                ENV_HEAL_DEADLINE, raw, default,
+            )
+        except ValueError:
+            log.warning(
+                "%s=%r is not a number; using %.0fs",
+                ENV_HEAL_DEADLINE, raw, default,
+            )
+    return default
+
+
+def default_heal_policy() -> RetryPolicy:
+    """The supervisor's heal-wait policy, deadline-capped by
+    ``HEAT3D_HEAL_DEADLINE_S``: same shape as the measurement scripts'
+    gate (probe every 60 s, 1.5x backoff capped at 5 min, jittered —
+    every probe is a claim attempt). In ``wait`` mode the deadline is
+    where an unhealable backend finally re-raises instead of waiting
+    forever; in ``auto`` mode it is what triggers the elastic
+    fallback."""
+    return RetryPolicy(
+        base_delay_s=60.0,
+        multiplier=1.5,
+        max_delay_s=300.0,
+        jitter_frac=0.1,
+        deadline_s=heal_deadline_s(),
+    )
+
+
+def probe_survivors(
+    plan=None,
+    device_probe: Optional[Callable[[], Optional[int]]] = None,
+) -> Optional[int]:
+    """How many devices survive, or None when nothing answers.
+
+    The injected :class:`~heat3d_tpu.resilience.faults.FaultPlan`
+    override is consulted first (the deterministic CPU tier), then the
+    caller's ``device_probe`` (tests), then the bounded out-of-process
+    ``backendprobe.probe_device_count`` — never an in-process
+    ``jax.devices()`` that can wedge forever."""
+    if plan is not None:
+        override = plan.device_override()
+        if override is not None:
+            return override
+    if device_probe is not None:
+        return device_probe()
+    from heat3d_tpu.utils.backendprobe import probe_device_count
+
+    return probe_device_count()
+
+
+def survivor_config(base_cfg, num_devices: int):
+    """The certified degraded config for ``num_devices`` survivors, or
+    None when no candidate passes.
+
+    Candidates come from the tuner's mesh factorizations
+    (``tune.space.survivor_candidates``): slab-first, each validated by
+    the PRODUCTION rules — ``SolverConfig.__post_init__`` plus a real
+    solver build (``prune_reason``) — and by the re-stitch contract
+    (``padded_shape`` preserved, so the checkpoint saved on the dead
+    mesh stitches onto the new one). The first certified candidate
+    wins; None means the caller must fall back to heal-wait semantics.
+    """
+    if num_devices < 1:
+        return None
+    from heat3d_tpu.tune.space import survivor_candidates
+
+    cands = survivor_candidates(base_cfg, num_devices)
+    return cands[0] if cands else None
+
+
+def refactor_and_restitch(
+    new_cfg,
+    make_solver_for: Callable[[object], object],
+    ckpt_root: str,
+    *,
+    old_mesh,
+    step: int,
+    survivors: int,
+    direction: str = "degrade",
+):
+    """Rebuild the solver on ``new_cfg``'s mesh and re-stitch the newest
+    good generation onto it. Returns ``(solver, loaded, quarantined,
+    restitch_s)`` with the supervisor's ``load_latest_generation``
+    semantics (``loaded`` None = nothing loadable; the caller applies
+    the same refuse-to-restart rules as a normal resume).
+
+    Side effects: the dead mesh's cached exchange plans are dropped and
+    the survivor mesh's plan pre-built (``exchange_plan_built`` audits
+    the rebuild during the recovery, not the first post-resume step),
+    and ONE ``elastic_refactor`` ledger event records old/new mesh,
+    survivor count and re-stitch seconds — the outage-attribution row
+    ``heat3d obs timeline`` reads."""
+    from heat3d_tpu.resilience.supervisor import load_latest_generation
+
+    t0 = time.monotonic()
+    solver = make_solver_for(new_cfg)
+    loaded, quarantined = load_latest_generation(solver, ckpt_root)
+    restitch_s = time.monotonic() - t0
+
+    # plan hygiene: the dead mesh's precomputed permutations can never be
+    # exchanged again this process — drop them, and pre-build the
+    # survivor mesh's plan so the audit event lands inside the recovery
+    # window (both fail soft: plans rebuild on demand at the first step
+    # either way)
+    try:
+        from heat3d_tpu.parallel import plan as planmod
+
+        planmod.drop_plans_for_mesh(tuple(old_mesh))
+        planmod.plan_for(new_cfg, width=max(1, new_cfg.time_blocking))
+    except Exception as e:  # noqa: BLE001 - plan warm-up is best-effort
+        log.warning("exchange-plan rebuild deferred to first step: %s", e)
+
+    obs.get().event(
+        "elastic_refactor",
+        direction=direction,
+        old_mesh=list(old_mesh),
+        new_mesh=list(new_cfg.mesh.shape),
+        old_devices=int(
+            old_mesh[0] * old_mesh[1] * old_mesh[2]
+        ),
+        survivors=int(survivors),
+        lost_devices=int(
+            old_mesh[0] * old_mesh[1] * old_mesh[2]
+            - new_cfg.mesh.num_devices
+        ),
+        restitch_s=round(restitch_s, 6),
+        step=int(step),
+        resumed_from=None if loaded is None else int(loaded[1]),
+        quarantined=quarantined,
+    )
+    obs.REGISTRY.counter(
+        "elastic_refactors_total", "survivor-mesh re-factorizations"
+    ).inc(direction=direction)
+    log.warning(
+        "elastic refactor (%s): mesh %s -> %s (%d survivor(s)), "
+        "re-stitch %.3fs",
+        direction, tuple(old_mesh), new_cfg.mesh.shape, survivors,
+        restitch_s,
+    )
+    return solver, loaded, quarantined, restitch_s
+
+

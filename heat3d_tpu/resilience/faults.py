@@ -16,6 +16,17 @@ Fault kinds:
 - ``backend-loss:step=N[:down=K]`` — the first time the supervised run
   reaches global step >= N, raise :class:`InjectedBackendLoss`; the next
   K heal-probes (default 1) report the backend down, then healthy.
+- ``partial-device-loss:step=N:keep=K`` (or ``batch=N`` for the serving
+  tier) — raise :class:`InjectedBackendLoss` at global step >= N (or
+  before the Nth packed serve batch, 0-based), and make every
+  device-count probe afterwards report only K surviving devices
+  (:meth:`FaultPlan.device_override`) — the elastic-degradation
+  injection primitive (docs/RESILIENCE.md "Elastic degradation").
+  ``down=D`` makes the first D heal-probes report fully down first
+  (default 0: the survivors answer immediately — a partial loss is not
+  an outage); ``restore=R`` restores full capacity after R shrunken
+  device probes (default 0 = the loss persists), the re-expand tests'
+  knob.
 - ``hang:step=N`` — at global step >= N, sleep just past the supervisor's
   watchdog budget, then raise :class:`InjectedHang` — the
   hang-until-deadline scenario (a wedged tunnel that never errors).
@@ -79,6 +90,8 @@ def _parse_spec(spec: str) -> List[_Fault]:
                 ) from None
         known = {
             "backend-loss": {"step", "down"},
+            "partial-device-loss": {"step", "batch", "keep", "down",
+                                    "restore"},
             "hang": {"step"},
             "sigterm": {"step", "row"},
             "corrupt-shard": {"save"},
@@ -93,6 +106,21 @@ def _parse_spec(spec: str) -> List[_Fault]:
             raise ValueError(
                 f"{ENV_SPEC}: fault {kind!r} got unknown params {sorted(bad)}"
             )
+        if kind == "partial-device-loss":
+            # explicit validation at PARSE time: a partial loss without a
+            # survivor count (or with both/neither trigger points) would
+            # only fail deep inside a recovery, where the diagnosis is
+            # worst
+            if params.get("keep", 0) < 1:
+                raise ValueError(
+                    f"{ENV_SPEC}: partial-device-loss needs keep=K >= 1 "
+                    "(the surviving device count)"
+                )
+            if ("step" in params) == ("batch" in params):
+                raise ValueError(
+                    f"{ENV_SPEC}: partial-device-loss needs exactly one "
+                    "of step=N (supervised runs) or batch=N (serve tier)"
+                )
         faults.append(_Fault(kind, params, key=part.replace(":", "_")))
     return faults
 
@@ -111,6 +139,11 @@ class FaultPlan:
         self._fired: set = set()
         self._down_probes_left = 0
         self._saves_seen = 0
+        # partial-device-loss state: the survivor count device probes
+        # report while the loss persists, and how many shrunken probes
+        # remain before full capacity "returns" (0 = persists forever)
+        self._device_keep: Optional[int] = None
+        self._device_restore = 0
 
     @classmethod
     def from_env(cls, environ=None) -> "FaultPlan":
@@ -172,6 +205,17 @@ class FaultPlan:
                 raise InjectedBackendLoss(
                     f"injected backend loss at step {global_step}"
                 )
+            if (
+                f.kind == "partial-device-loss"
+                and "step" in f.params
+                and global_step >= f.params["step"]
+            ):
+                self._mark_fired(f, step=global_step)
+                self._arm_partial(f)
+                raise InjectedBackendLoss(
+                    f"injected partial device loss at step {global_step} "
+                    f"({f.params['keep']} device(s) survive)"
+                )
             if f.kind == "hang" and global_step >= f.params["step"]:
                 self._mark_fired(f, step=global_step)
                 # sleep PAST the watchdog budget: the supervisor must
@@ -202,6 +246,32 @@ class FaultPlan:
                 self._mark_fired(f, row=row_index)
                 self._sigterm_self()
 
+    def _arm_partial(self, f: _Fault) -> None:
+        # down=0 by default: a PARTIAL loss is not an outage — the
+        # surviving devices answer the very first heal probe, and only
+        # the device-count probe reports the shrunken set
+        self._down_probes_left = f.params.get("down", 0)
+        self._device_keep = f.params["keep"]
+        self._device_restore = f.params.get("restore", 0)
+
+    def on_serve_batch(self, batch_index: int):
+        """Called by the async serve engine before executing packed batch
+        ``batch_index`` (0-based count of batches started) — the serving
+        tier's partial-device-loss instrumentation point."""
+        for f in self.faults:
+            if (
+                f.kind == "partial-device-loss"
+                and "batch" in f.params
+                and batch_index >= f.params["batch"]
+                and not self._has_fired(f)
+            ):
+                self._mark_fired(f, batch=batch_index)
+                self._arm_partial(f)
+                raise InjectedBackendLoss(
+                    f"injected partial device loss at serve batch "
+                    f"{batch_index} ({f.params['keep']} device(s) survive)"
+                )
+
     def on_checkpoint_saved(self, gen_dir: str):
         """Called after each checkpoint generation lands on disk."""
         self._saves_seen += 1
@@ -222,6 +292,21 @@ class FaultPlan:
             self._down_probes_left -= 1
             return "down"
         return None
+
+    def device_override(self) -> Optional[int]:
+        """Survivor-count probe hook: the shrunken device count while an
+        injected partial loss persists, None = no override (use the real
+        ``backendprobe.probe_device_count``). With ``restore=R`` the
+        override decays after R probes — full capacity "returns", the
+        re-expand path's trigger."""
+        if self._device_keep is None:
+            return None
+        keep = self._device_keep
+        if self._device_restore > 0:
+            self._device_restore -= 1
+            if self._device_restore == 0:
+                self._device_keep = None
+        return keep
 
     @staticmethod
     def _sigterm_self():

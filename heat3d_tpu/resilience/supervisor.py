@@ -59,16 +59,13 @@ log = get_logger("heat3d.supervisor")
 
 GEN_PREFIX = "gen-"
 
-# Heal-wait default: the same shape as the measurement scripts' gate
-# (probe every 60 s, 1.5x backoff capped at 5 min — every probe is a claim
-# attempt, see backendprobe), bounded at 30 min like TPU_WAIT.
-DEFAULT_HEAL_POLICY = RetryPolicy(
-    base_delay_s=60.0,
-    multiplier=1.5,
-    max_delay_s=300.0,
-    jitter_frac=0.1,
-    deadline_s=1800.0,
-)
+# Heal-wait default: the supervisor resolves its policy through
+# elastic.default_heal_policy() — probe every 60 s, 1.5x backoff capped at
+# 5 min (every probe is a claim attempt, see backendprobe), with the total
+# deadline owned by the HEAT3D_HEAL_DEADLINE_S knob (default 30 min, like
+# TPU_WAIT; in `auto` heal mode its expiry is what triggers the elastic
+# fallback — docs/RESILIENCE.md "Elastic degradation"). The one schedule
+# definition lives in resilience/elastic.py.
 
 
 class BackendSuspect(RuntimeError):
@@ -87,6 +84,13 @@ class Recovery:
     heal_attempts: int
     resumed_from: Optional[int]
     quarantined: List[str] = dataclasses.field(default_factory=list)
+    # elastic recoveries re-factorized the mesh over survivors instead of
+    # waiting the backend whole again (resilience/elastic.py); the mesh
+    # the run continued on is part of the record so degraded progress can
+    # never masquerade as full-capacity progress downstream
+    elastic: bool = False
+    mesh_shape: Optional[List[int]] = None
+    restitch_s: Optional[float] = None
 
     def to_record(self) -> dict:
         return dataclasses.asdict(self)
@@ -106,6 +110,13 @@ class SupervisedResult:
     # post-run operation on u (gather, slice dump, golden check) must use
     # this one, not the caller's stale instance
     solver: object = None
+    # elastic-degradation provenance (resilience/elastic.py): whether the
+    # run FINISHED degraded, the mesh it finished on, and how many
+    # re-factorizations (degrade + expand) happened — run summaries carry
+    # these so degraded throughput is labeled at the source
+    degraded: bool = False
+    mesh_shape: Optional[tuple] = None
+    refactors: int = 0
 
     def to_record(self) -> dict:
         return {
@@ -114,6 +125,11 @@ class SupervisedResult:
             "resumed_from": self.resumed_from,
             "checkpoints_written": self.checkpoints_written,
             "recoveries": [r.to_record() for r in self.recoveries],
+            "degraded": self.degraded,
+            "mesh_shape": (
+                None if self.mesh_shape is None else list(self.mesh_shape)
+            ),
+            "refactors": self.refactors,
         }
 
 
@@ -272,6 +288,11 @@ def run_supervised(
     faults: Optional[FaultPlan] = None,
     init: str = "hot-cube",
     finish_with_residual: bool = True,
+    heal_mode: Optional[str] = None,
+    make_solver_for: Optional[Callable[[object], object]] = None,
+    base_cfg=None,
+    device_probe: Optional[Callable[[], Optional[int]]] = None,
+    reexpand: bool = False,
 ) -> SupervisedResult:
     """Run ``solver`` to global step ``total_steps`` under supervision.
 
@@ -288,11 +309,47 @@ def run_supervised(
     factory that re-resolves devices). ``probe`` overrides the heal probe
     (tests); ``faults`` overrides the env-parsed
     :class:`~heat3d_tpu.resilience.faults.FaultPlan`.
+
+    **Elastic degradation** (``heal_mode='elastic'|'auto'``;
+    resilience/elastic.py, docs/RESILIENCE.md): on a confirmed loss the
+    supervisor re-probes the device set (``device_probe`` override >
+    fault-plan override > bounded out-of-process probe) and, when
+    devices are missing, re-factorizes the mesh over the survivors —
+    ``make_solver_for(new_cfg)`` rebuilds the solver for the certified
+    degraded config derived from ``base_cfg`` (default: ``solver.cfg``),
+    the ``gen-<step>`` shards re-stitch onto the new mesh through the
+    existing cross-mesh path, and the run continues degraded
+    (``elastic_refactor`` + ``degraded_mode_enter`` ledger events). In
+    ``auto`` mode the heal DEADLINE is the trigger: wait first, degrade
+    only when the deadline expires or the healed backend comes back
+    smaller. ``reexpand=True`` opts into re-factorizing back to the
+    original mesh when a later probe reports full capacity
+    (``degraded_mode_exit``).
     """
+    from heat3d_tpu.resilience import elastic
+
     from heat3d_tpu.utils.timing import force_sync
 
     plan = faults if faults is not None else FaultPlan.from_env()
-    policy = heal_policy or DEFAULT_HEAL_POLICY
+    policy = heal_policy or elastic.default_heal_policy()
+    mode = elastic.resolve_heal_mode(heal_mode)
+    if base_cfg is None:
+        base_cfg = getattr(solver, "cfg", None)
+    if make_solver_for is None and mode != "wait":
+        # elastic needs a config-parameterized factory; without one the
+        # mode silently behaving like `wait` would be a lie — refuse
+        raise ValueError(
+            f"heal_mode={mode!r} needs make_solver_for (a cfg -> solver "
+            "factory; HeatSolver3D.run_supervised provides one)"
+        )
+    if base_cfg is None and mode != "wait":
+        raise ValueError(
+            f"heal_mode={mode!r} needs base_cfg (or a solver with a .cfg)"
+        )
+    cur_cfg = base_cfg
+    degraded = False
+    degraded_t0 = 0.0
+    refactors = 0
     recoveries: List[Recovery] = []
     checkpoints = 0
     resumed_from = None
@@ -426,24 +483,116 @@ def run_supervised(
                 "supervised run lost the backend at step %d (%s: %s); "
                 "waiting for heal", failed_step, kind, e,
             )
+            # Elastic triage (resilience/elastic.py) — the three modes
+            # genuinely differ here:
+            #   wait    — wait for the ORIGINAL platform to heal; the
+            #             deadline re-raises (PR 1 behavior).
+            #   elastic — a loss is a RE-PLAN event: the wait's success
+            #             criterion is ANY SURVIVORS ANSWERING (the
+            #             device-set probe), so the run re-plans the
+            #             moment the surviving chips respond instead of
+            #             waiting out the platform-heal deadline.
+            #   auto    — wait-first: the full platform heal wait runs,
+            #             and the DEADLINE (or a backend that healed
+            #             smaller) is what triggers the elastic fallback.
             with ledger.span(
-                "heal_wait", step=failed_step, failure=kind
+                "heal_wait", step=failed_step, failure=kind, mode=mode
             ) as heal_span:
-                outcome = _wait_for_heal(policy, plan, want_platform, probe)
+                if mode == "elastic":
+                    outcome = policy.run(
+                        lambda: elastic.probe_survivors(plan, device_probe)
+                    )
+                else:
+                    outcome = _wait_for_heal(
+                        policy, plan, want_platform, probe
+                    )
                 heal_span.add(
                     ok=outcome.ok,
                     attempts=len(outcome.attempts),
                     stop_reason=outcome.stop_reason,
                 )
-            if not outcome.ok:
-                log.error(
-                    "backend never healed (%s after %.1fs); re-raising",
-                    outcome.stop_reason, outcome.elapsed_s,
+            survivors = None
+            if mode == "elastic":
+                if not outcome.ok:
+                    log.error(
+                        "no survivors answered (%s after %.1fs); "
+                        "re-raising", outcome.stop_reason,
+                        outcome.elapsed_s,
+                    )
+                    raise
+                survivors = outcome.value
+            elif not outcome.ok:
+                if mode == "auto":
+                    survivors = elastic.probe_survivors(plan, device_probe)
+                if not survivors:
+                    log.error(
+                        "backend never healed (%s after %.1fs); re-raising",
+                        outcome.stop_reason, outcome.elapsed_s,
+                    )
+                    raise
+            elif mode == "auto":
+                survivors = elastic.probe_survivors(plan, device_probe)
+
+            new_cfg = None
+            if (
+                survivors is not None
+                and survivors < cur_cfg.mesh.num_devices
+            ):
+                new_cfg = elastic.survivor_config(base_cfg, survivors)
+                if new_cfg is None:
+                    if not outcome.ok:
+                        log.error(
+                            "no certified survivor mesh for %d device(s) "
+                            "and the heal deadline expired; re-raising",
+                            survivors,
+                        )
+                        raise
+                    # the backend healed but no degraded config
+                    # certifies (e.g. the padded shape cannot survive
+                    # the re-stitch contract): resume on the current
+                    # mesh — honest fallback, loudly logged
+                    log.warning(
+                        "no certified survivor mesh for %d device(s); "
+                        "resuming on the current mesh", survivors,
+                    )
+            restitch_s = None
+            if new_cfg is not None:
+                solver, loaded, quarantined, restitch_s = (
+                    elastic.refactor_and_restitch(
+                        new_cfg, make_solver_for, ckpt_root,
+                        old_mesh=cur_cfg.mesh.shape, step=failed_step,
+                        survivors=survivors,
+                    )
                 )
-                raise
-            if make_solver is not None:
-                solver = make_solver()
-            loaded, quarantined = load_latest_generation(solver, ckpt_root)
+                cur_cfg = new_cfg
+                refactors += 1
+                if (
+                    not degraded
+                    and new_cfg.mesh.num_devices
+                    < base_cfg.mesh.num_devices
+                ):
+                    degraded = True
+                    degraded_t0 = time.monotonic()
+                    ledger.event(
+                        "degraded_mode_enter",
+                        step=failed_step,
+                        mesh=list(new_cfg.mesh.shape),
+                        survivors=int(survivors),
+                    )
+            else:
+                if (
+                    make_solver_for is not None
+                    and cur_cfg is not None
+                    and cur_cfg is not base_cfg
+                ):
+                    # already degraded: a rebuild must land on the mesh
+                    # the run is CURRENTLY on, not the original one
+                    solver = make_solver_for(cur_cfg)
+                elif make_solver is not None:
+                    solver = make_solver()
+                loaded, quarantined = load_latest_generation(
+                    solver, ckpt_root
+                )
             if loaded is not None:
                 u, done = loaded
             elif generation_dirs(ckpt_root):
@@ -470,6 +619,15 @@ def run_supervised(
                     heal_attempts=len(outcome.attempts),
                     resumed_from=done if loaded is not None else None,
                     quarantined=quarantined,
+                    elastic=new_cfg is not None,
+                    mesh_shape=(
+                        list(cur_cfg.mesh.shape)
+                        if cur_cfg is not None
+                        else None
+                    ),
+                    restitch_s=(
+                        None if restitch_s is None else round(restitch_s, 3)
+                    ),
                 )
             )
             ledger.set_context(
@@ -478,7 +636,7 @@ def run_supervised(
             rec_record = recoveries[-1].to_record()
             rec_record["kind_"] = rec_record.pop("kind")  # envelope owns kind
             ledger.event("recovery", **rec_record)
-            if make_solver is not None:
+            if make_solver is not None or new_cfg is not None:
                 # the rebuilt solver may have landed on different hardware
                 # or a different mesh (cross-mesh stitch-resume), where its
                 # compiled step program — and therefore its cost model —
@@ -505,6 +663,58 @@ def run_supervised(
             continue
         done = nxt
 
+        # Opt-in re-expand (the elastic loop's other half): while
+        # degraded, after each generation lands, ask whether capacity
+        # returned — and if the FULL original device count answers,
+        # re-factorize back onto the original mesh, re-stitching from
+        # the generation just saved. Probing only at checkpoint
+        # boundaries bounds the probe cost; skipping the final boundary
+        # avoids a pointless rebuild the run would never step on.
+        if degraded and reexpand and done < total_steps:
+            survivors = elastic.probe_survivors(plan, device_probe)
+            if (
+                survivors is not None
+                and survivors >= base_cfg.mesh.num_devices
+            ):
+                try:
+                    # commit NOTHING until the re-stitch proves loadable:
+                    # rebinding `solver` before the load check would leave
+                    # a full-mesh solver driving the degraded-mesh `u` on
+                    # the next chunk — exactly the crash this except
+                    # exists to prevent
+                    exp_solver, loaded, quarantined, _rs = (
+                        elastic.refactor_and_restitch(
+                            base_cfg, make_solver_for, ckpt_root,
+                            old_mesh=cur_cfg.mesh.shape, step=done,
+                            survivors=survivors, direction="expand",
+                        )
+                    )
+                    if loaded is None:
+                        raise RuntimeError(
+                            "no loadable generation for re-expand"
+                        )
+                    solver = exp_solver
+                    u, done = loaded
+                    cur_cfg = base_cfg
+                    refactors += 1
+                    degraded = False
+                    ledger.event(
+                        "degraded_mode_exit",
+                        step=done,
+                        mesh=list(base_cfg.mesh.shape),
+                        degraded_s=round(
+                            time.monotonic() - degraded_t0, 3
+                        ),
+                    )
+                except Exception as rexc:  # noqa: BLE001 - stay degraded
+                    # a failed expand must not kill a run that is
+                    # healthily serving degraded — log and keep going;
+                    # the next boundary retries
+                    log.warning(
+                        "re-expand to %s failed (%s); staying degraded",
+                        base_cfg.mesh.shape, rexc,
+                    )
+
     ledger.event(
         "supervised_end",
         steps_done=done,
@@ -512,6 +722,11 @@ def run_supervised(
         resumed_from=resumed_from,
         checkpoints_written=checkpoints,
         recoveries=len(recoveries),
+        degraded=degraded,
+        refactors=refactors,
+        mesh=(
+            None if cur_cfg is None else list(cur_cfg.mesh.shape)
+        ),
     )
     ledger.set_context(generation=None)
     return SupervisedResult(
@@ -523,4 +738,7 @@ def run_supervised(
         checkpoints_written=checkpoints,
         recoveries=recoveries,
         solver=solver,
+        degraded=degraded,
+        mesh_shape=(None if cur_cfg is None else cur_cfg.mesh.shape),
+        refactors=refactors,
     )

@@ -20,7 +20,13 @@ a stored program wrong to reuse:
 - **toolchain provenance** — jax version, platform, device kind/count.
   A serialized executable is a build artifact of one exact stack;
   anything else deserializes to undefined behavior, so a mismatch is
-  ``stale`` and falls back to a fresh compile, never an error.
+  ``stale`` and falls back to a fresh compile, never an error. The
+  device count here is also what makes serve-tier elastic degradation
+  free (docs/SERVING.md "Degraded-mode serving"): when the engine
+  rebuilds a bucket after a backend loss shrank the mesh, the degraded
+  warm-up keys (and staleness-checks) on the NEW device count — a
+  full-mesh executable can never load into the survivor mesh, and the
+  recompile is the ordinary miss path, not a special case.
 
 Ledger contract (docs/OBSERVABILITY.md §6): every warm-up lands exactly
 one of ``aot_cache_hit`` (with the measured ``load_s``) /

@@ -329,13 +329,14 @@ def _main(args) -> int:
         )
         slo_mod.record_verdict(report)
         slo_mod.print_report(report, out=sys.stderr)
-        # only serve_latency objectives are judgeable from a drain (the
-        # queue has no step spans or device profile) — say so, so a
-        # mixed spec's step/halo ceilings don't read as enforced here
+        # only serve-side objectives (latency, degraded budget) are
+        # judgeable from a drain (the queue has no step spans or device
+        # profile) — say so, so a mixed spec's step/halo ceilings don't
+        # read as enforced here
         other = [
             o["name"]
             for o in report["objectives"]
-            if o["kind"] != "serve_latency"
+            if o["kind"] not in ("serve_latency", "serve_degraded")
         ]
         if other:
             print(

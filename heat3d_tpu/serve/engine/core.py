@@ -31,6 +31,19 @@ overlapping dispatch with in-flight work):
   deliver, and the failures are surfaced explicitly
   (:attr:`AsyncServeEngine.failures`, and :meth:`drain` re-raises after
   streaming what landed — the queue's contract);
+- **backend-loss failures are requeued, not failed** (serve-tier
+  elastic degradation, docs/SERVING.md "Degraded-mode serving"):
+  :func:`is_backend_loss` classifies a batch failure as device-runtime
+  loss vs scenario error; a loss puts the chunk's requests back in the
+  pending set IN ORDER, drops the worker's cached ensembles (the
+  rebuild lands on whatever mesh now exists — the AOT store keys carry
+  the device count, so a shrunken mesh is a clean stale→recompile, not
+  a poisoned load), backs off through the shared
+  :class:`~heat3d_tpu.resilience.retry.RetryPolicy` schedule, and
+  opens the ``degraded`` window on :class:`ServeStats` (``degraded_s``
+  in ``serve_metrics_summary`` — the budget the SLO layer's
+  ``serve_degraded`` objective judges). Retries exhausted (or losses
+  during shutdown run-down) fail the chunk exactly as before;
 - :meth:`shutdown` is graceful: stop accepting, run down every
   dispatched batch, join the workers, close with ONE
   ``serve_metrics_summary`` event (the SLO layer's source, same shape
@@ -78,6 +91,30 @@ log = get_logger(__name__)
 ENV_WORKERS = "HEAT3D_SERVE_WORKERS"
 DEFAULT_WORKERS = 2
 
+# Backend-loss requeue backoff (the ONE RetryPolicy implementation —
+# resilience/retry.py): attempts-capped, no deadline — a service must
+# bound retries per chunk, and the dispatcher owns global liveness.
+DEFAULT_REQUEUE_POLICY_KW = dict(
+    max_attempts=4, base_delay_s=0.5, multiplier=2.0, max_delay_s=10.0
+)
+
+
+def is_backend_loss(exc: BaseException) -> bool:
+    """Device-runtime loss (requeue) vs scenario error (fail).
+
+    Injected faults (:class:`~heat3d_tpu.resilience.faults.InjectedFault`)
+    and jaxlib runtime errors (XlaRuntimeError and friends — the device
+    runtime speaking, not the scenario) classify as loss; Python-level
+    config/validation errors (ValueError, TypeError, ...) stay scenario
+    errors and fail the chunk immediately — retrying a bad config
+    forever would hide the bug behind backoff."""
+    from heat3d_tpu.resilience.faults import InjectedFault
+
+    if isinstance(exc, InjectedFault):
+        return True
+    mod = type(exc).__module__ or ""
+    return mod.startswith("jaxlib")
+
 # request lifecycle states
 _PENDING = "pending"
 _DISPATCHED = "dispatched"
@@ -96,6 +133,9 @@ class _Tracked:
     state: str = _PENDING
     result: Optional[ServeResult] = None
     error: Optional[str] = None
+    # backend-loss requeue count: the chunk fails for real once the
+    # shared RetryPolicy's attempt cap is reached
+    attempts: int = 0
 
 
 class _BucketWorker(threading.Thread):
@@ -182,6 +222,8 @@ class AsyncServeEngine:
         aot_dir: Optional[str] = None,
         before_execute: Optional[Callable[[str, List[int]], None]] = None,
         autostart: bool = True,
+        retry_policy=None,
+        faults=None,
     ):
         self.max_batch = max_batch or _env_int(ENV_MAX_BATCH, DEFAULT_MAX_BATCH)
         self.max_depth = max_depth or _env_int(
@@ -199,6 +241,18 @@ class AsyncServeEngine:
         # hidden stall.
         self._aot = True if aot is None else bool(aot)
         self.before_execute = before_execute
+        # backend-loss requeue: the shared RetryPolicy supplies the
+        # attempt cap + backoff schedule (tests inject a millisecond
+        # policy); the fault plan supplies the deterministic serve-tier
+        # injection point (partial-device-loss:batch=N)
+        from heat3d_tpu.resilience.faults import FaultPlan
+        from heat3d_tpu.resilience.retry import RetryPolicy
+
+        self._retry = retry_policy or RetryPolicy(
+            **DEFAULT_REQUEUE_POLICY_KW
+        )
+        self._faults = faults if faults is not None else FaultPlan.from_env()
+        self._batch_seq = 0
 
         self._cond = threading.Condition()
         self._req: Dict[int, _Tracked] = {}
@@ -395,6 +449,8 @@ class AsyncServeEngine:
         with self._cond:
             self._in_flight += 1
             self._max_in_flight = max(self._max_in_flight, self._in_flight)
+            batch_seq = self._batch_seq
+            self._batch_seq += 1
         try:
             base = chunk[0].base
             members = [r.scenario for r in chunk]
@@ -422,6 +478,11 @@ class AsyncServeEngine:
                 self.before_execute(
                     bucket_s, [r.request_id for r in chunk]
                 )
+            # the serve-tier fault-injection point: a declared
+            # partial-device-loss:batch=N fires here, lands in the
+            # except below, classifies as backend loss, and requeues —
+            # exactly the path a real mid-batch device loss takes
+            self._faults.on_serve_batch(batch_seq)
             with obs.get().span(
                 "serve_batch", members=len(chunk), padded=padded
             ) as span:
@@ -441,6 +502,8 @@ class AsyncServeEngine:
                 in_flight=self._in_flight,
             )
         except BaseException as e:  # noqa: BLE001 - fail THIS chunk only
+            if self._maybe_requeue(worker, chunk, e):
+                return
             self._fail_chunk(chunk, e)
             return
         finally:
@@ -450,6 +513,14 @@ class AsyncServeEngine:
             [(r.request_id, r.submitted_at) for r in chunk],
             bucket_s, budgets, fields, residuals, snapshots, self._stats,
         )
+        # a REQUEUED chunk finally succeeding closes the degraded window
+        # (cumulative seconds retained for the SLO budget). Other
+        # buckets' healthy batches don't: while a lost chunk is still
+        # backing off, the service IS degraded, and letting unaffected
+        # traffic close the window would undercount the very budget the
+        # serve_degraded objective meters.
+        if any(r.attempts for r in chunk):
+            self._stats.clear_degraded()
         with self._cond:
             for r, res in zip(chunk, results):
                 r.result = res
@@ -458,9 +529,72 @@ class AsyncServeEngine:
             self._cond.notify_all()
         self._stats.observe_depth(len(self))
 
+    def _maybe_requeue(
+        self, worker: _BucketWorker, chunk: List[_Tracked], exc: BaseException
+    ) -> bool:
+        """Backend-loss triage for a failed batch: requeue the chunk with
+        backoff (True) or let it fail (False — scenario errors, retries
+        exhausted, or shutdown run-down, where retry-forever would hang
+        the join)."""
+        if not is_backend_loss(exc):
+            return False
+        attempt = max(r.attempts for r in chunk) + 1
+        cap = self._retry.max_attempts or 1
+        if attempt >= cap:
+            log.warning(
+                "serve batch lost its backend %d time(s); retries "
+                "exhausted — failing the chunk", attempt,
+            )
+            return False
+        with self._cond:
+            if self._stop:
+                return False
+            for r in chunk:
+                r.state = _PENDING
+                r.attempts = attempt
+        # rebuild, don't reuse: the cached ensembles hold programs
+        # compiled for the pre-loss device set; dropping them makes the
+        # next dispatch rebuild on whatever mesh NOW exists (the AOT
+        # store keys carry the device count — stale→recompile, never a
+        # wrong-mesh load)
+        worker.solvers.clear()
+        # attempt 1 = this chunk's first loss: it takes its own reference
+        # on the degraded window (refcounted — another chunk recovering
+        # must not stop the clock while this one still backs off)
+        self._stats.mark_degraded(new=attempt == 1)
+        delay = self._retry.delay_for(attempt)
+        obs.get().event(
+            "serve_requeue",
+            bucket=worker.bucket,
+            request_ids=[r.request_id for r in chunk],
+            attempt=attempt,
+            backoff_s=round(delay, 6),
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
+        log.warning(
+            "serve batch backend loss (%s request(s), attempt %d): "
+            "requeued with %.2fs backoff",
+            len(chunk), attempt, delay,
+        )
+        # backoff INSIDE the worker thread, while the bucket is still
+        # marked busy: the dispatcher cannot re-dispatch this bucket
+        # until the worker frees it, so the sleep IS the backoff —
+        # submission and other buckets keep flowing meanwhile
+        if delay > 0:
+            time.sleep(delay)
+        with self._cond:
+            self._cond.notify_all()
+        return True
+
     def _fail_chunk(self, chunk: List[_Tracked], exc: BaseException) -> None:
         err = f"{type(exc).__name__}: {str(exc)[:300]}"
         log.warning("serve batch failed (%s request(s)): %s", len(chunk), err)
+        if any(r.attempts for r in chunk):
+            # a requeued chunk finally failing RESOLVES its degraded
+            # window (seconds retained for the SLO budget): the requests
+            # are failed, not pending — leaving the clock running would
+            # count every healthy hour after this failure as degraded
+            self._stats.clear_degraded()
         with self._cond:
             for r in chunk:
                 if r.state in (_DONE, _FAILED):
@@ -621,6 +755,8 @@ class AsyncServeEngine:
                 "workers": self.workers,
                 "max_in_flight": self._max_in_flight,
                 "accepted_in_flight": self._accepted_in_flight,
+                "requeues": self._stats.requeues,
+                "degraded_s": round(self._stats.degraded_seconds(), 6),
                 "aot": dict(self._aot_stats),
             }
 

@@ -220,6 +220,20 @@ class ServeStats:
         self.depth_max = 0
         self.batches = 0
         self.delivered = 0
+        # degraded-mode accounting (serve-tier elastic degradation,
+        # docs/SERVING.md "Degraded-mode serving"): a backend-loss
+        # requeue opens a degraded window, the next successful batch
+        # closes it; cumulative seconds + the live flag ride the
+        # serve_metrics_summary the SLO layer judges (`serve_degraded`
+        # objective: degraded-seconds budget)
+        self.requeues = 0
+        # refcounted: each DISTINCT degraded chunk holds one reference;
+        # the window closes only when the last one resolves — chunk A's
+        # quick recovery must not stop the clock while chunk B is still
+        # backing off
+        self._degraded_open = 0
+        self._degraded_since: Optional[float] = None
+        self._degraded_s_total = 0.0
         self._depth_gauge = obs.REGISTRY.gauge(
             "serve_queue_depth", "pending scenario requests"
         )
@@ -240,6 +254,44 @@ class ServeStats:
         self._batch_hist.observe(members)
         with self._lock:
             self.batches += 1
+
+    def mark_degraded(self, new: bool = True) -> None:
+        """A backend-loss requeue happened: count it, and — when this is
+        the chunk's FIRST requeue (``new``) — take one reference on the
+        degraded window (a chunk re-requeued on a later attempt already
+        holds its reference)."""
+        with self._lock:
+            self.requeues += 1
+            if new:
+                self._degraded_open += 1
+            if self._degraded_since is None:
+                self._degraded_since = time.monotonic()
+        obs.REGISTRY.counter(
+            "serve_requeues_total", "backend-loss batch requeues"
+        ).inc()
+
+    def clear_degraded(self) -> None:
+        """Drop one degraded-chunk reference — the engine calls this when
+        a REQUEUED chunk resolves (success or final failure). The window
+        closes (cumulative seconds retained for the SLO budget) only when
+        the LAST open chunk resolves."""
+        with self._lock:
+            if self._degraded_open > 0:
+                self._degraded_open -= 1
+            if self._degraded_open == 0 and self._degraded_since is not None:
+                self._degraded_s_total += (
+                    time.monotonic() - self._degraded_since
+                )
+                self._degraded_since = None
+
+    def degraded_seconds(self) -> float:
+        with self._lock:
+            live = (
+                0.0
+                if self._degraded_since is None
+                else time.monotonic() - self._degraded_since
+            )
+            return self._degraded_s_total + live
 
     def observe_result(self, bucket: str, latency_s: float) -> None:
         # bucket-labelled: the SLO layer judges latency PER BUCKET (a
@@ -279,12 +331,25 @@ class ServeStats:
                     # to be mistaken for exact (count/max stay exact)
                     rec["clipped"] = True
                 buckets[bucket] = rec
+            live_degraded = self._degraded_since is not None
+            degraded_s = self._degraded_s_total + (
+                0.0
+                if self._degraded_since is None
+                else time.monotonic() - self._degraded_since
+            )
             return {
                 "buckets": buckets,
                 "depth_max": self.depth_max,
                 "batches": self.batches,
                 "delivered": self.delivered,
                 "pending": pending,
+                # degraded-mode serving provenance: ALWAYS present (0.0
+                # on a healthy drain) so the SLO serve_degraded
+                # objective reads a value, never no_data, from any
+                # summary this code produced
+                "degraded": live_degraded,
+                "degraded_s": round(degraded_s, 6),
+                "requeues": self.requeues,
             }
 
 

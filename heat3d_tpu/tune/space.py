@@ -132,6 +132,46 @@ def mesh_candidates(num_devices: int) -> Tuple[Tuple[int, int, int], ...]:
     return tuple(seen)
 
 
+def survivor_candidates(
+    base: SolverConfig, num_devices: int, validate: bool = True
+) -> List[SolverConfig]:
+    """Certified degraded configs for ``base`` over ``num_devices``
+    surviving devices — the elastic-degradation re-plan source
+    (``resilience/elastic.py``; docs/RESILIENCE.md "Elastic
+    degradation").
+
+    Candidates are the same factorizations the tuner searches
+    (:func:`mesh_candidates`, slab-first), filtered by THREE production
+    gates so a degraded run only ever lands on a config a normal run
+    could have used:
+
+    - ``SolverConfig.__post_init__`` (structural validity — the
+      ``apply_knobs`` path);
+    - the **re-stitch contract**: the candidate's ``padded_shape`` must
+      equal ``base``'s, because the checkpoint being stitched onto the
+      survivor mesh was saved in ``base``'s storage shape (cross-mesh
+      resume across different bc-paddings is unsupported —
+      ``HeatSolver3D.load_checkpoint`` rejects it);
+    - :func:`prune_reason` building the real solver (capability gates:
+      backend, transport, local-extent minima for the configured
+      time_blocking).
+    """
+    out: List[SolverConfig] = []
+    if num_devices < 1:
+        return out
+    for m in mesh_candidates(num_devices):
+        try:
+            cfg = apply_knobs(base, {"mesh": m})
+        except ValueError:
+            continue
+        if cfg.padded_shape != base.padded_shape:
+            continue
+        if validate and prune_reason(cfg) is not None:
+            continue
+        out.append(cfg)
+    return out
+
+
 def apply_knobs(base: SolverConfig, knobs: Dict[str, Any]) -> SolverConfig:
     """``base`` with ``knobs`` overridden (``mesh`` takes a (Px,Py,Pz)
     tuple). Raises ``ValueError`` for structurally invalid combos —

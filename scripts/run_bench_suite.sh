@@ -408,3 +408,63 @@ if [[ -z "${SKIP_EQN_SMOKE:-}" ]]; then
 else
   note "suite: eqn smoke skipped (SKIP_EQN_SMOKE=1)"
 fi
+
+# Elastic-heal smoke (informational, beside the other smokes;
+# docs/RESILIENCE.md "Elastic degradation"): a supervised run on a forced
+# 4-device CPU mesh loses 2 devices mid-run (injected partial-device-loss)
+# under --heal-mode elastic, must re-factorize onto the survivors and
+# COMPLETE without operator action — machine-checked from the ledger
+# (elastic_refactor + degraded_mode_enter + supervised_end at the target
+# step) with the JSON verdict on the console. Always CPU (the path under
+# test is the re-plan, not the chip), sub-minute. Fails SOFT;
+# SKIP_ELASTIC_SMOKE=1 skips.
+if [[ -z "${SKIP_ELASTIC_SMOKE:-}" ]]; then
+  ELASTIC_LED="${OUT%.jsonl}.elastic.ledger.jsonl"
+  ELASTIC_CK="${OUT%.jsonl}.elastic_ck"
+  : > "$ELASTIC_LED"
+  rm -rf "$ELASTIC_CK"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    HEAT3D_FAULTS="partial-device-loss:step=4:keep=2" \
+    HEAT3D_LEDGER="$ELASTIC_LED" \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
+    python -m heat3d_tpu.cli --grid 8 --steps 8 --mesh 4 1 1 \
+    --backend jnp --checkpoint "$ELASTIC_CK" --checkpoint-every 2 \
+    --supervise --heal-mode elastic >> "$SUITE_LOG" 2>&1 \
+    || note "suite: elastic smoke run failed (rc=$?) — informational"
+  python - "$ELASTIC_LED" <<'PYEOF' \
+    || note "suite: elastic smoke verdict failed — informational"
+import json, sys
+evs = []
+try:
+    with open(sys.argv[1]) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    evs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+except OSError:
+    pass
+ref = [e for e in evs if e.get("event") == "elastic_refactor"]
+ent = [e for e in evs if e.get("event") == "degraded_mode_enter"]
+end = [e for e in evs if e.get("event") == "supervised_end"]
+ok = (
+    len(ref) >= 1 and len(ent) >= 1 and len(end) >= 1
+    and end[-1].get("steps_done") == 8
+    and ref[-1].get("new_mesh") == [2, 1, 1]
+)
+print(json.dumps({"elastic_smoke": {
+    "ok": ok,
+    "refactors": len(ref),
+    "new_mesh": ref[-1].get("new_mesh") if ref else None,
+    "restitch_s": ref[-1].get("restitch_s") if ref else None,
+    "steps_done": end[-1].get("steps_done") if end else None,
+    "degraded": end[-1].get("degraded") if end else None,
+}}))
+sys.exit(0 if ok else 1)
+PYEOF
+else
+  note "suite: elastic smoke skipped (SKIP_ELASTIC_SMOKE=1)"
+fi

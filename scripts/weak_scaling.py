@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Weak-scaling + chaos harness — the numbers the paper's >=90%
+weak-scaling claim is judged against, with elastic degradation measured
+in the same session (docs/RESILIENCE.md "Elastic degradation";
+docs/POD_RUNBOOK.md "Chaos drill").
+
+Per mesh rung the grid GROWS with the mesh (constant local block), and
+the harness reports per-chip Gcell/s, the halo share of the step's
+compiled byte traffic (the roofline model's denominator), and the
+weak-scaling efficiency vs the 1-chip rung. With ``--chaos keep=K`` the
+largest rung additionally runs SUPERVISED with an injected
+partial-device-loss mid-run (resilience/faults.py) under
+``heal_mode=elastic``: the run re-factorizes onto the K survivors,
+finishes degraded, and the harness reports recovery time (heal wait +
+re-stitch, from the ledger's ``elastic_refactor`` event) and
+post-degradation throughput as a second, ``post_heal: true`` row.
+
+Rows are JSONL (``bench: "weak_scaling"``), lint-enforced by
+``scripts/check_provenance.py``: every row carries ``ts``, ``platform``,
+``mesh_shape`` and a boolean ``post_heal`` — degraded throughput can
+never pollute the scaling record unlabeled. The session ledger
+(``--ledger`` / ``$HEAT3D_LEDGER``) carries the full event stream;
+``heat3d obs summary`` prints the elastic section, ``heat3d obs
+timeline`` attributes the outage.
+
+Usage (CPU smoke — the same matrix the pod session runs bigger)::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \\
+      python scripts/weak_scaling.py --local 16 \\
+      --meshes 1x1x1,2x1x1,4x1x1 --steps 20 --chaos keep=2 \\
+      --out weak_scaling.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def parse_meshes(spec: str):
+    out = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        dims = tuple(int(x) for x in tok.lower().split("x"))
+        if len(dims) != 3 or any(d < 1 for d in dims):
+            raise ValueError(f"--meshes entry {tok!r} (want PxQxR)")
+        out.append(dims)
+    if not out:
+        raise ValueError("--meshes: no rungs")
+    return out
+
+
+def parse_chaos(spec):
+    """``keep=K[,at-frac=F]`` -> (keep, at_frac). None disables chaos."""
+    if not spec:
+        return None
+    keep, at_frac = None, 0.5
+    for tok in spec.split(","):
+        k, _, v = tok.strip().partition("=")
+        if k == "keep":
+            keep = int(v)
+        elif k == "at-frac":
+            at_frac = float(v)
+        else:
+            raise ValueError(f"--chaos: unknown key {k!r} (keep, at-frac)")
+    if keep is None or keep < 1:
+        raise ValueError("--chaos needs keep=K >= 1")
+    if not 0.0 < at_frac < 1.0:
+        raise ValueError("--chaos at-frac must be in (0, 1)")
+    return keep, at_frac
+
+
+def halo_share_model(solver) -> float:
+    """Halo bytes as a fraction of the step's compiled byte traffic per
+    exchange period (XLA cost model — the same accounting bench rows and
+    the roofline report use). Raises on failure; the caller records
+    null (telemetry fails soft, never the rung)."""
+    from heat3d_tpu.obs.perf.roofline import halo_cost_fields, step_cost_fields
+
+    step = step_cost_fields(solver)["cost_bytes_per_step"]
+    halo = halo_cost_fields(solver.cfg)["cost_bytes_per_step"]
+    k = max(1, solver.cfg.time_blocking)
+    if not step or not halo:
+        raise ValueError("cost model reported no bytes")
+    return max(0.0, min(1.0, halo / (step * k)))
+
+
+def timed_gcell(solver, u, steps: int) -> float:
+    """Gcell updates/s of ``steps`` compiled updates (one warmup step
+    outside the window, force-synced boundaries — the bench discipline
+    at harness scale)."""
+    from heat3d_tpu.utils.timing import force_sync
+
+    u = solver.run(u, 1)
+    force_sync(u)
+    t0 = time.perf_counter()
+    u = solver.run(u, steps)
+    force_sync(u)
+    dt = time.perf_counter() - t0
+    return solver.cfg.grid.num_cells * steps / dt / 1e9
+
+
+def run_rung(cfg, steps: int):
+    """One healthy rung: (gcell_per_sec, halo_share|None)."""
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+
+    solver = HeatSolver3D(cfg)
+    u = solver.init_state("hot-cube")
+    rate = timed_gcell(solver, u, steps)
+    try:
+        share = halo_share_model(solver)
+    except Exception as e:  # noqa: BLE001 - model share is telemetry
+        print(f"weak_scaling: halo share model unavailable: {e}",
+              file=sys.stderr)
+        share = None
+    return rate, share
+
+
+def run_chaos_rung(cfg, steps: int, keep: int, at_frac: float,
+                   tmp_root: str):
+    """The chaos rung: a supervised run losing devices mid-flight under
+    heal_mode=elastic. Returns (result, recovery_s, restitch_s,
+    degraded_rate)."""
+    import jax
+
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+    from heat3d_tpu.resilience.faults import FaultPlan, _parse_spec
+
+    loss_step = max(1, int(steps * at_frac))
+    ckpt_every = max(1, loss_step // 2)
+    plan = FaultPlan(
+        _parse_spec(f"partial-device-loss:step={loss_step}:keep={keep}")
+    )
+    solver = HeatSolver3D(cfg)
+    result = solver.run_supervised(
+        total_steps=steps,
+        ckpt_root=tmp_root,
+        checkpoint_every=ckpt_every,
+        faults=plan,
+        heal_mode="elastic",
+        # in-process probe: this harness injects the loss itself, so the
+        # backend is genuinely alive — the elastic re-plan (not outage
+        # detection) is what's being measured; the out-of-process probe
+        # tier has its own tests
+        probe=lambda: jax.default_backend(),
+        want_platform=jax.default_backend(),
+    )
+    # the judged recovery time comes from the in-process Recovery
+    # records (heal wait + re-stitch) — correct with or without an
+    # active ledger, unlike a ledger re-read
+    recovery_s = sum(
+        r.heal_wait_s + (r.restitch_s or 0.0) for r in result.recoveries
+    )
+    restitch_s = sum(
+        r.restitch_s for r in result.recoveries if r.restitch_s is not None
+    )
+    # post-degradation throughput: a timed window on the survivor-mesh
+    # solver the supervised run finished with
+    degraded_rate = timed_gcell(result.solver, result.u, max(4, steps // 4))
+    return result, recovery_s, restitch_s, degraded_rate
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--local", type=int, default=32,
+                    help="per-chip grid edge (weak scaling: grid = "
+                    "local * mesh extent per axis)")
+    ap.add_argument("--meshes", default="1x1x1,2x1x1,4x1x1",
+                    help="comma-separated mesh rungs, PxQxR each")
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--stencil", choices=["7pt", "27pt"], default="7pt")
+    ap.add_argument("--dtype", choices=["fp32", "bf16"], default="fp32")
+    ap.add_argument("--time-blocking", type=int, default=1)
+    ap.add_argument("--chaos", default=None, metavar="keep=K[,at-frac=F]",
+                    help="inject a partial device loss on the LARGEST "
+                    "rung (supervised, heal_mode=elastic): K devices "
+                    "survive, the loss fires at frac F of the step "
+                    "budget (default 0.5)")
+    ap.add_argument("--out", default="weak_scaling.jsonl",
+                    help="JSONL rows (bench: weak_scaling)")
+    ap.add_argument("--ledger", default=None,
+                    help="run ledger path (default $HEAT3D_LEDGER)")
+    ap.add_argument("--ckpt-root", default=None,
+                    help="chaos-rung checkpoint directory (default: a "
+                    "fresh tempdir)")
+    args = ap.parse_args(argv)
+
+    meshes = parse_meshes(args.meshes)
+    chaos = parse_chaos(args.chaos)
+
+    # jax import AFTER arg validation: a bad flag fails in ms
+    import jax
+
+    from heat3d_tpu import obs
+    from heat3d_tpu.core.config import (
+        GridConfig,
+        MeshConfig,
+        Precision,
+        SolverConfig,
+        StencilConfig,
+    )
+
+    obs.activate(args.ledger, meta={"entry": "weak_scaling"})
+    platform = jax.default_backend()
+    ndev_avail = len(jax.devices())
+    # the chaos drill targets the largest rung that will actually RUN
+    # (keyed off meshes[-1] alone, a too-big last rung would silently
+    # drop the drill the operator asked for)
+    chaos_target = None
+    if chaos:
+        runnable = [
+            m for m in meshes
+            if 1 < m[0] * m[1] * m[2] <= ndev_avail
+            and chaos[0] < m[0] * m[1] * m[2]
+        ]
+        chaos_target = runnable[-1] if runnable else None
+        if chaos_target is None:
+            print(
+                f"weak_scaling: --chaos keep={chaos[0]} has no runnable "
+                f"multi-device rung (have {ndev_avail} device(s)) — the "
+                "chaos drill will NOT run",
+                file=sys.stderr,
+            )
+    rows = []
+    baseline_per_chip = None
+    try:
+        for mesh in meshes:
+            n = mesh[0] * mesh[1] * mesh[2]
+            if n > ndev_avail:
+                print(
+                    f"weak_scaling: rung {mesh} needs {n} devices, have "
+                    f"{ndev_avail}; skipping", file=sys.stderr,
+                )
+                continue
+            grid = tuple(args.local * m for m in mesh)
+            cfg = SolverConfig(
+                grid=GridConfig(shape=grid),
+                stencil=StencilConfig(kind=args.stencil),
+                mesh=MeshConfig(shape=mesh),
+                precision=(
+                    Precision.bf16() if args.dtype == "bf16"
+                    else Precision.fp32()
+                ),
+                backend="jnp",
+                time_blocking=args.time_blocking,
+            )
+            rate, share = run_rung(cfg, args.steps)
+            per_chip = rate / n
+            if baseline_per_chip is None:
+                baseline_per_chip = per_chip
+            row = {
+                "bench": "weak_scaling",
+                "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "platform": platform,
+                "mesh_shape": list(mesh),
+                "devices": n,
+                "grid": list(grid),
+                "local_grid": [args.local] * 3,
+                "stencil": args.stencil,
+                "dtype": cfg.precision.storage,
+                "time_blocking": args.time_blocking,
+                "steps": args.steps,
+                "gcell_per_sec": round(rate, 6),
+                "gcell_per_sec_per_chip": round(per_chip, 6),
+                "halo_share_model": (
+                    None if share is None else round(share, 6)
+                ),
+                "weak_efficiency": round(per_chip / baseline_per_chip, 4),
+                "post_heal": False,
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+            if chaos and mesh == chaos_target:
+                keep, at_frac = chaos
+                import tempfile
+
+                root = args.ckpt_root or tempfile.mkdtemp(
+                    prefix="heat3d_chaos_"
+                )
+                result, recovery_s, restitch_s, degraded_rate = (
+                    run_chaos_rung(cfg, args.steps, keep, at_frac, root)
+                )
+                dmesh = result.mesh_shape or (keep, 1, 1)
+                dn = dmesh[0] * dmesh[1] * dmesh[2]
+                row = {
+                    "bench": "weak_scaling",
+                    "ts": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    ),
+                    "platform": platform,
+                    "mesh_shape": list(dmesh),
+                    "devices": dn,
+                    "grid": list(grid),
+                    "local_grid": [args.local] * 3,
+                    "stencil": args.stencil,
+                    "dtype": cfg.precision.storage,
+                    "time_blocking": args.time_blocking,
+                    "steps": args.steps,
+                    "gcell_per_sec": round(degraded_rate, 6),
+                    "gcell_per_sec_per_chip": round(degraded_rate / dn, 6),
+                    "halo_share_model": None,
+                    "post_heal": True,
+                    "injected_mesh": list(mesh),
+                    "survivors": keep,
+                    "recovery_s": round(recovery_s, 6),
+                    "restitch_s": round(restitch_s, 6),
+                    "refactors": result.refactors,
+                    "degraded_of_baseline": round(
+                        (degraded_rate / dn) / baseline_per_chip, 4
+                    ),
+                }
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+    except BaseException as e:
+        obs.deactivate(rc=1, error=f"{type(e).__name__}: {str(e)[:200]}")
+        raise
+
+    with open(args.out, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    print(
+        f"weak_scaling: {len(rows)} row(s) -> {args.out}", file=sys.stderr
+    )
+    obs.deactivate(rc=0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

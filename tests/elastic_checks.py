@@ -1,0 +1,334 @@
+"""Elastic-degradation acceptance battery, run on a REAL 4-device CPU mesh.
+
+Executed as a subprocess by tests/test_elastic.py (env -u
+PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=4) — the deterministic
+CPU tier of the chip/host-loss scenario the elastic path exists for:
+a supervised run loses 2 of its 4 devices mid-flight, re-factorizes the
+mesh over the survivors, re-stitches the newest generation, and finishes
+degraded WITHOUT operator action (docs/RESILIENCE.md "Elastic
+degradation").
+
+Not named test_* so pytest does not collect it in the main process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import jax
+import numpy as np
+
+from heat3d_tpu import obs
+from heat3d_tpu.core.config import GridConfig, MeshConfig, SolverConfig
+from heat3d_tpu.models.heat3d import HeatSolver3D
+from heat3d_tpu.resilience.faults import FaultPlan, InjectedBackendLoss, _parse_spec
+from heat3d_tpu.resilience.retry import RetryPolicy
+
+FAST_HEAL = RetryPolicy(
+    base_delay_s=0.01, multiplier=1.5, max_delay_s=0.05, deadline_s=5.0
+)
+
+
+def _cfg(mesh=(4, 1, 1), grid=8):
+    return SolverConfig(
+        grid=GridConfig.cube(grid),
+        mesh=MeshConfig(shape=mesh),
+        backend="jnp",
+    )
+
+
+def _events(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def check_elastic_degrade_bitwise():
+    """THE acceptance property: a supervised run losing 2 of 4 devices at
+    step 8 re-factorizes (4,1,1)->(2,1,1), resumes from gen-8 on the
+    survivor mesh, completes to step 12, and its final field is BITWISE
+    equal to a fresh run on the small mesh resumed from the SAME
+    checkpoint — with elastic_refactor + degraded_mode_enter in the
+    ledger."""
+    tmp = tempfile.mkdtemp(prefix="elastic_bitwise_")
+    root = os.path.join(tmp, "ck")
+    led = os.path.join(tmp, "led.jsonl")
+    obs.activate(led)
+    plan = FaultPlan(_parse_spec("partial-device-loss:step=8:keep=2"))
+    cfg = _cfg()
+    res = HeatSolver3D(cfg).run_supervised(
+        12, root, checkpoint_every=4,
+        heal_policy=FAST_HEAL, probe=lambda: "cpu", faults=plan,
+        heal_mode="elastic",
+    )
+    obs.deactivate()
+    assert res.steps_done == 12
+    assert res.degraded and res.mesh_shape == (2, 1, 1)
+    assert res.refactors == 1
+    assert res.solver.cfg.mesh.shape == (2, 1, 1)
+    assert len(res.recoveries) == 1
+    rec = res.recoveries[0]
+    assert rec.kind == "backend-loss" and rec.elastic
+    assert rec.mesh_shape == [2, 1, 1] and rec.resumed_from == 8
+
+    evs = _events(led)
+    refs = [e for e in evs if e.get("event") == "elastic_refactor"]
+    assert len(refs) == 1
+    assert refs[0]["old_mesh"] == [4, 1, 1]
+    assert refs[0]["new_mesh"] == [2, 1, 1]
+    assert refs[0]["survivors"] == 2 and refs[0]["lost_devices"] == 2
+    assert refs[0]["restitch_s"] >= 0
+    enters = [e for e in evs if e.get("event") == "degraded_mode_enter"]
+    assert len(enters) == 1 and enters[0]["mesh"] == [2, 1, 1]
+    ends = [e for e in evs if e.get("event") == "supervised_end"]
+    assert ends and ends[-1]["degraded"] is True
+    assert ends[-1]["mesh"] == [2, 1, 1]
+
+    # the bitwise oracle: a FRESH small-mesh run resumed from the SAME
+    # gen-8 checkpoint must produce the identical final field + residual
+    root2 = os.path.join(tmp, "ck2")
+    os.makedirs(root2)
+    shutil.copytree(
+        os.path.join(root, "gen-00000008"),
+        os.path.join(root2, "gen-00000008"),
+    )
+    small_cfg = dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 1, 1)))
+    ref = HeatSolver3D(small_cfg).run_supervised(
+        12, root2, checkpoint_every=4,
+        heal_policy=FAST_HEAL, probe=lambda: "cpu", faults=FaultPlan(),
+    )
+    assert ref.resumed_from == 8
+    assert np.array_equal(np.asarray(res.u), np.asarray(ref.u))
+    assert res.residual == ref.residual
+    print("elastic_degrade_bitwise OK")
+
+
+def check_auto_mode_deadline_triggers_elastic():
+    """`auto`: heal-wait first; the DEADLINE (not an operator) flips the
+    run to elastic — a full backend loss whose probes never heal within
+    the deadline degrades onto the survivors the device probe reports.
+    The same scenario in `wait` mode re-raises (the PR 1 contract)."""
+    tmp = tempfile.mkdtemp(prefix="elastic_auto_")
+    deadline = RetryPolicy(
+        base_delay_s=0.01, multiplier=1.0, max_delay_s=0.01, deadline_s=0.05
+    )
+
+    def run(mode, root):
+        plan = FaultPlan(_parse_spec("backend-loss:step=4:down=999"))
+        return HeatSolver3D(_cfg()).run_supervised(
+            8, root, checkpoint_every=4,
+            heal_policy=deadline, probe=lambda: "cpu", faults=plan,
+            heal_mode=mode, device_probe=lambda: 2,
+        )
+
+    try:
+        run("wait", os.path.join(tmp, "wait_ck"))
+        raise AssertionError("wait mode must re-raise at the deadline")
+    except InjectedBackendLoss:
+        pass
+
+    res = run("auto", os.path.join(tmp, "auto_ck"))
+    assert res.steps_done == 8
+    assert res.degraded and res.mesh_shape == (2, 1, 1)
+    assert res.recoveries[0].elastic
+    print("auto_mode_deadline_triggers_elastic OK")
+
+
+def check_elastic_replans_during_platform_outage():
+    """THE elastic-vs-auto distinction: with the platform probe down for
+    the whole window (down=999), `elastic` re-plans on the FIRST
+    survivor answer (one heal attempt, no deadline burned) while `auto`
+    waits out the platform-heal deadline before falling back — same
+    final state, different waiting."""
+    tmp = tempfile.mkdtemp(prefix="elastic_replan_")
+    deadline = RetryPolicy(
+        base_delay_s=0.02, multiplier=1.0, max_delay_s=0.02, deadline_s=0.2
+    )
+
+    def run(mode, root):
+        plan = FaultPlan(
+            _parse_spec("partial-device-loss:step=4:keep=2:down=999")
+        )
+        return HeatSolver3D(_cfg()).run_supervised(
+            8, root, checkpoint_every=4,
+            heal_policy=deadline, probe=lambda: "cpu", faults=plan,
+            heal_mode=mode,
+        )
+
+    res_e = run("elastic", os.path.join(tmp, "e"))
+    assert res_e.steps_done == 8 and res_e.mesh_shape == (2, 1, 1)
+    rec = res_e.recoveries[0]
+    assert rec.heal_attempts == 1  # first survivor answer won
+    assert rec.heal_wait_s < 0.2  # the deadline was never burned
+
+    res_a = run("auto", os.path.join(tmp, "a"))
+    assert res_a.steps_done == 8 and res_a.mesh_shape == (2, 1, 1)
+    rec = res_a.recoveries[0]
+    assert rec.heal_attempts > 1  # waited the platform heal out
+    assert rec.heal_wait_s >= 0.2  # ...to the deadline, then degraded
+    print("elastic_replans_during_platform_outage OK")
+
+
+def check_reexpand_restores_full_mesh():
+    """Opt-in re-expand: when capacity returns (the injected loss's
+    restore knob), a degraded run re-factorizes BACK onto the original
+    mesh at the next checkpoint boundary — degraded_mode_exit closes the
+    window and the final field matches a clean uninterrupted run."""
+    tmp = tempfile.mkdtemp(prefix="elastic_reexpand_")
+    root = os.path.join(tmp, "ck")
+    led = os.path.join(tmp, "led.jsonl")
+    obs.activate(led)
+    # restore=1: the refactor's survivor probe sees 2 devices ONCE, then
+    # full capacity answers again — the re-expand trigger
+    plan = FaultPlan(
+        _parse_spec("partial-device-loss:step=4:keep=2:restore=1")
+    )
+    cfg = _cfg()
+    res = HeatSolver3D(cfg).run_supervised(
+        12, root, checkpoint_every=2,
+        heal_policy=FAST_HEAL, probe=lambda: "cpu", faults=plan,
+        heal_mode="elastic", reexpand=True,
+        device_probe=lambda: len(jax.devices()),
+    )
+    obs.deactivate()
+    assert res.steps_done == 12
+    assert not res.degraded
+    assert res.mesh_shape == (4, 1, 1)
+    assert res.refactors == 2
+    assert res.solver.cfg.mesh.shape == (4, 1, 1)
+
+    evs = _events(led)
+    refs = [e for e in evs if e.get("event") == "elastic_refactor"]
+    assert [r["direction"] for r in refs] == ["degrade", "expand"]
+    assert refs[1]["old_mesh"] == [2, 1, 1]
+    assert refs[1]["new_mesh"] == [4, 1, 1]
+    exits = [e for e in evs if e.get("event") == "degraded_mode_exit"]
+    assert len(exits) == 1 and exits[0]["degraded_s"] >= 0
+
+    clean = HeatSolver3D(_cfg()).run_supervised(
+        12, os.path.join(tmp, "clean"), checkpoint_every=2,
+        heal_policy=FAST_HEAL, probe=lambda: "cpu", faults=FaultPlan(),
+    )
+    # the degraded segment stepped on a DIFFERENT mesh — same math, same
+    # grid, but not the same program, so the oracle is the multidevice
+    # decomposition tolerance, not bitwise
+    np.testing.assert_allclose(
+        np.asarray(res.u), np.asarray(clean.u), rtol=1e-5, atol=1e-5
+    )
+    print("reexpand_restores_full_mesh OK")
+
+
+def check_engine_requeue_and_degraded_slo():
+    """Serve-tier elastic degradation: an injected mid-batch backend loss
+    REQUEUES the chunk (backoff through the shared RetryPolicy) instead
+    of failing the streams; every request delivers, per-stream
+    submission order holds, results are byte-identical to an uninjected
+    synchronous drain, and the degraded window is visible in the
+    metrics summary + judged by the SLO serve_degraded objective."""
+    from heat3d_tpu.obs.perf import slo as slo_mod
+    from heat3d_tpu.serve.engine import AsyncServeEngine
+    from heat3d_tpu.serve.queue import ScenarioQueue
+    from heat3d_tpu.serve.scenario import Scenario
+
+    tmp = tempfile.mkdtemp(prefix="elastic_engine_")
+    led = os.path.join(tmp, "led.jsonl")
+    base = SolverConfig(
+        grid=GridConfig.cube(8), mesh=MeshConfig(shape=(2, 1, 1)),
+        backend="jnp",
+    )
+    scenarios = [
+        Scenario(alpha=0.3 + 0.1 * i, steps=4 + i, seed=i) for i in range(4)
+    ]
+
+    obs.activate(led)
+    plan = FaultPlan(_parse_spec("partial-device-loss:batch=0:keep=1"))
+    fast = RetryPolicy(
+        max_attempts=4, base_delay_s=0.01, multiplier=1.0, max_delay_s=0.01
+    )
+    eng = AsyncServeEngine(
+        batch_mesh=1, aot=False, autostart=False,
+        retry_policy=fast, faults=plan,
+    )
+    rids = {}
+    for i, sc in enumerate(scenarios):
+        stream = "a" if i % 2 == 0 else "b"
+        rids[eng.submit(base, sc, stream=stream)] = stream
+    got = {}
+    order = {"a": [], "b": []}
+    for r in eng.drain():
+        got[r.request_id] = r
+        order[rids[r.request_id]].append(r.request_id)
+    eng.shutdown()
+    summary = eng.metrics_summary()
+    stats = eng.stats()
+    obs.deactivate()
+
+    # retried, not failed: every stream's results delivered, in order
+    assert len(got) == 4 and not eng.failures
+    assert order["a"] == sorted(order["a"])
+    assert order["b"] == sorted(order["b"])
+    assert stats["requeues"] >= 1
+    assert stats["degraded_s"] > 0
+    assert summary["requeues"] >= 1 and summary["degraded_s"] > 0
+    assert summary["degraded"] is False  # the retry SUCCEEDED: window closed
+
+    evs = _events(led)
+    req = [e for e in evs if e.get("event") == "serve_requeue"]
+    assert len(req) >= 1 and req[0]["attempt"] == 1
+    assert req[0]["backoff_s"] >= 0
+
+    # byte-identical to an uninjected synchronous drain (shared
+    # run_packed_batch body — the loss must not change delivered values)
+    q = ScenarioQueue(batch_mesh=1)
+    sync_rids = [q.submit(base, sc) for sc in scenarios]
+    sync = {r.request_id: r for r in q.drain()}
+    for rid_async, rid_sync in zip(sorted(got), sync_rids):
+        assert np.array_equal(got[rid_async].field, sync[rid_sync].field)
+
+    # the SLO layer judges the degraded budget from the ledger alone
+    spec = {
+        "objectives": [
+            {"name": "degraded-budget", "kind": "serve_degraded",
+             "max_s": 1e-9},
+        ],
+    }
+    report = slo_mod.evaluate(evs, spec)
+    (obj,) = report["objectives"]
+    assert obj["status"] == "breach" and obj["value"] > 0
+    assert report["verdict"] == "breach"
+    spec["objectives"][0]["max_s"] = 3600.0
+    report = slo_mod.evaluate(evs, spec)
+    assert report["verdict"] == "pass"
+    print("engine_requeue_and_degraded_slo OK")
+
+
+CHECKS = {
+    "degrade": [check_elastic_degrade_bitwise],
+    "auto": [check_auto_mode_deadline_triggers_elastic],
+    "replan": [check_elastic_replans_during_platform_outage],
+    "reexpand": [check_reexpand_restores_full_mesh],
+    "engine": [check_engine_requeue_and_degraded_slo],
+}
+
+
+def main(argv):
+    names = argv or list(CHECKS)
+    for name in names:
+        for fn in CHECKS[name]:
+            fn()
+    print("ALL ELASTIC CHECKS PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
