@@ -138,6 +138,51 @@ def check_row(r: dict) -> list:
                 "recovery_s missing/non-numeric on a post_heal row (the "
                 "chaos harness's judged recovery time)"
             )
+    elif r.get("bench") == "soak":
+        # sustained-traffic soak rows (serve/loadgen.py): the verdict's
+        # conservation law and chaos provenance must be provable from
+        # the row alone — a soak rate without its shed/degraded context
+        # is indistinguishable from an unloaded drain
+        if "platform" not in r:
+            problems.append("missing 'platform'")
+        if not isinstance(r.get("duration_s"), (int, float)):
+            problems.append(
+                "duration_s missing/non-numeric (soak length unprovable)"
+            )
+        if not isinstance(r.get("seed"), int):
+            problems.append(
+                "seed missing/non-int (the soak schedule is unreplayable)"
+            )
+        counts = {
+            k: r.get(k)
+            for k in ("submitted", "admitted", "shed", "delivered")
+        }
+        if not all(isinstance(v, int) for v in counts.values()):
+            problems.append(
+                "submitted/admitted/shed/delivered must all be ints "
+                "(shed-request accounting lost)"
+            )
+        elif counts["admitted"] + counts["shed"] != counts["submitted"]:
+            problems.append(
+                "admitted + shed != submitted (the soak's conservation "
+                "law does not hold on this row)"
+            )
+        if not isinstance(
+            r.get("sustained_member_gcell_per_s"), (int, float)
+        ):
+            problems.append(
+                "sustained_member_gcell_per_s missing/non-numeric (the "
+                "judged soak metric)"
+            )
+        if not isinstance(r.get("degraded_s"), (int, float)):
+            problems.append(
+                "degraded_s missing/non-numeric (chaos provenance — a "
+                "soak without its degraded budget is unjudgeable)"
+            )
+        if not (isinstance(r.get("slo"), str) and r["slo"]):
+            problems.append(
+                "slo missing/empty (the verdict that judged this soak)"
+            )
     if r.get("bench") in ("throughput", "halo") and not isinstance(
         r.get("sync_rtt_s"), (int, float)
     ):
@@ -186,6 +231,7 @@ def check_file(path: str, start_line: int = 1) -> list:
                 "throughput",
                 "halo",
                 "weak_scaling",
+                "soak",
             ):
                 continue  # foreign lines (headline records, notes) pass
             for p in check_row(r):

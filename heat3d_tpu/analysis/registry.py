@@ -218,6 +218,33 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                               "serving bucket (measured seconds; absent "
                               "on a warm AOT hit — the cold-start "
                               "acceptance signal)"},
+    # sustained-traffic soak (serve/loadgen.py) + overload control
+    "serve_shed": {"kind": "point",
+                   "module": "serve/queue.py, serve/engine/core.py",
+                   "desc": "a submission rejected by admission control "
+                           "(reason depth|stream_cap, per-stream "
+                           "occupancy) — shed traffic is accounted, "
+                           "never silent"},
+    "serve_admission": {"kind": "point", "module": "serve/engine/core.py",
+                        "desc": "first submission admitted on a new "
+                                "stream (its admission cap + the global "
+                                "depth cap)"},
+    "worker_scale": {"kind": "point", "module": "serve/engine/core.py",
+                     "desc": "execution-slot count moved with load "
+                             "(direction, slots from/to, backlog, last "
+                             "batch-execute seconds)"},
+    "aot_prewarm": {"kind": "point", "module": "serve/engine/core.py",
+                    "desc": "an executable built/loaded ahead of traffic "
+                            "(bucket, padded size, forecast members, "
+                            "build seconds)"},
+    "loadgen_start": {"kind": "point", "module": "serve/loadgen.py",
+                      "desc": "soak replay begins: seed, duration, "
+                              "arrival count, streams"},
+    "soak_verdict": {"kind": "point", "module": "serve/loadgen.py",
+                     "desc": "machine-checked soak outcome: accounting "
+                             "(admitted + shed == submitted), order, "
+                             "post-warmup compile stalls, sustained "
+                             "member-Gcell/s, degraded seconds"},
 }
 
 # Wrapper functions whose first argument is an event name (the taxonomy
@@ -334,6 +361,15 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
     "HEAT3D_SERVE_MAX_BATCH": {"module": "serve/queue.py",
                                "desc": "members per packed batch cap "
                                        "(default 64)"},
+    "HEAT3D_SERVE_MAX_PER_STREAM": {"module": "serve/engine/core.py",
+                                    "desc": "per-stream open-request "
+                                            "admission cap (default: the "
+                                            "global depth cap; set lower "
+                                            "for multi-tenant fairness)"},
+    "HEAT3D_LOADGEN_SEED": {"module": "serve/loadgen.py",
+                            "desc": "default seed for the soak arrival "
+                                    "schedule (the spec's seed field "
+                                    "wins)"},
     "HEAT3D_SERVE_WORKERS": {"module": "serve/engine/core.py",
                              "desc": "async engine concurrent batch-"
                                      "execution slots (default 2)"},

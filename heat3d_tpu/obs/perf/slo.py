@@ -85,6 +85,44 @@ DEFAULT_SPEC: Dict[str, Any] = {
 }
 
 
+def validate_spec(spec: Any, origin: str = "spec") -> Dict[str, Any]:
+    """Validate an in-memory objective spec (the loadgen soak passes its
+    mix's inline ``slo`` block through here — same rules as a file spec,
+    with ``origin`` naming the source in errors). Returns the spec with
+    objective names defaulted; raises ValueError on malformation."""
+    if not isinstance(spec, dict) or not isinstance(
+        spec.get("objectives"), list
+    ):
+        raise ValueError(f"{origin}: SLO spec needs an 'objectives' list")
+    for i, o in enumerate(spec["objectives"]):
+        if not isinstance(o, dict):
+            raise ValueError(f"{origin}: objective #{i} must be an object")
+        kind = o.get("kind")
+        if kind not in KINDS:
+            raise ValueError(
+                f"{origin}: objective #{i} kind must be one of {KINDS}, "
+                f"got {kind!r}"
+            )
+        target_key = "max_frac" if kind == "halo_share" else "max_s"
+        if not isinstance(o.get(target_key), (int, float)) or o[target_key] <= 0:
+            raise ValueError(
+                f"{origin}: objective #{i} ({o.get('name', kind)}) needs a "
+                f"positive {target_key}"
+            )
+        # p99 is soak-only in practice: the drain reservoir records
+        # p50/p95, and the load generator merges a full-sample p99 into
+        # the summary it hands evaluate()
+        if kind in ("serve_latency", "step_time") and o.get(
+            "percentile"
+        ) not in (50, 95, 99):
+            raise ValueError(
+                f"{origin}: objective #{i} percentile must be 50, 95 or "
+                "99 (the percentiles the metrics/soak layers record)"
+            )
+        o.setdefault("name", f"{kind}-#{i}")
+    return spec
+
+
 def load_spec(path: Optional[str] = None) -> Dict[str, Any]:
     """The objective spec at ``path`` (or ``$HEAT3D_SLO_SPEC``), validated;
     :data:`DEFAULT_SPEC` when neither is configured. Raises ValueError on
@@ -99,33 +137,7 @@ def load_spec(path: Optional[str] = None) -> Dict[str, Any]:
             spec = json.load(f)
         except json.JSONDecodeError as e:
             raise ValueError(f"{path}: unparseable SLO spec: {e}") from None
-    if not isinstance(spec, dict) or not isinstance(
-        spec.get("objectives"), list
-    ):
-        raise ValueError(f"{path}: SLO spec needs an 'objectives' list")
-    for i, o in enumerate(spec["objectives"]):
-        if not isinstance(o, dict):
-            raise ValueError(f"{path}: objective #{i} must be an object")
-        kind = o.get("kind")
-        if kind not in KINDS:
-            raise ValueError(
-                f"{path}: objective #{i} kind must be one of {KINDS}, "
-                f"got {kind!r}"
-            )
-        target_key = "max_frac" if kind == "halo_share" else "max_s"
-        if not isinstance(o.get(target_key), (int, float)) or o[target_key] <= 0:
-            raise ValueError(
-                f"{path}: objective #{i} ({o.get('name', kind)}) needs a "
-                f"positive {target_key}"
-            )
-        if kind in ("serve_latency", "step_time") and o.get(
-            "percentile"
-        ) not in (50, 95):
-            raise ValueError(
-                f"{path}: objective #{i} percentile must be 50 or 95 "
-                "(the percentiles the metrics layer records)"
-            )
-        o.setdefault("name", f"{kind}-#{i}")
+    spec = validate_spec(spec, origin=path)
     spec["path"] = path
     return spec
 

@@ -16,9 +16,11 @@ Fault kinds:
 - ``backend-loss:step=N[:down=K]`` — the first time the supervised run
   reaches global step >= N, raise :class:`InjectedBackendLoss`; the next
   K heal-probes (default 1) report the backend down, then healthy.
-- ``partial-device-loss:step=N:keep=K`` (or ``batch=N`` for the serving
-  tier) — raise :class:`InjectedBackendLoss` at global step >= N (or
-  before the Nth packed serve batch, 0-based), and make every
+- ``partial-device-loss:step=N:keep=K`` (or ``batch=N`` / ``after=S``
+  for the serving tier) — raise :class:`InjectedBackendLoss` at global
+  step >= N (or before the Nth packed serve batch, 0-based, or before
+  the first serve batch starting >= S seconds after the plan was built
+  — the soak's mid-run chaos trigger), and make every
   device-count probe afterwards report only K surviving devices
   (:meth:`FaultPlan.device_override`) — the elastic-degradation
   injection primitive (docs/RESILIENCE.md "Elastic degradation").
@@ -90,8 +92,8 @@ def _parse_spec(spec: str) -> List[_Fault]:
                 ) from None
         known = {
             "backend-loss": {"step", "down"},
-            "partial-device-loss": {"step", "batch", "keep", "down",
-                                    "restore"},
+            "partial-device-loss": {"step", "batch", "after", "keep",
+                                    "down", "restore"},
             "hang": {"step"},
             "sigterm": {"step", "row"},
             "corrupt-shard": {"save"},
@@ -116,10 +118,12 @@ def _parse_spec(spec: str) -> List[_Fault]:
                     f"{ENV_SPEC}: partial-device-loss needs keep=K >= 1 "
                     "(the surviving device count)"
                 )
-            if ("step" in params) == ("batch" in params):
+            triggers = sum(k in params for k in ("step", "batch", "after"))
+            if triggers != 1:
                 raise ValueError(
                     f"{ENV_SPEC}: partial-device-loss needs exactly one "
-                    "of step=N (supervised runs) or batch=N (serve tier)"
+                    "of step=N (supervised runs), batch=N, or "
+                    "after=SECONDS (serve tier)"
                 )
         faults.append(_Fault(kind, params, key=part.replace(":", "_")))
     return faults
@@ -136,6 +140,10 @@ class FaultPlan:
                  state_dir: Optional[str] = None):
         self.faults = faults or []
         self.state_dir = state_dir
+        # plan birth time: the after=SECONDS serve trigger's clock (the
+        # engine builds its plan at construction, so "after" means
+        # seconds into the serving session)
+        self._t0 = time.monotonic()
         self._fired: set = set()
         self._down_probes_left = 0
         self._saves_seen = 0
@@ -257,15 +265,24 @@ class FaultPlan:
     def on_serve_batch(self, batch_index: int):
         """Called by the async serve engine before executing packed batch
         ``batch_index`` (0-based count of batches started) — the serving
-        tier's partial-device-loss instrumentation point."""
+        tier's partial-device-loss instrumentation point. Fires on the
+        batch-count trigger (``batch=N``) or the elapsed-time trigger
+        (``after=S`` seconds since the plan was built — the soak's
+        mid-run chaos injection)."""
         for f in self.faults:
-            if (
-                f.kind == "partial-device-loss"
-                and "batch" in f.params
-                and batch_index >= f.params["batch"]
-                and not self._has_fired(f)
-            ):
-                self._mark_fired(f, batch=batch_index)
+            if f.kind != "partial-device-loss" or self._has_fired(f):
+                continue
+            hit_batch = (
+                "batch" in f.params and batch_index >= f.params["batch"]
+            )
+            elapsed = time.monotonic() - self._t0
+            hit_after = (
+                "after" in f.params and elapsed >= f.params["after"]
+            )
+            if hit_batch or hit_after:
+                self._mark_fired(
+                    f, batch=batch_index, elapsed_s=round(elapsed, 3)
+                )
                 self._arm_partial(f)
                 raise InjectedBackendLoss(
                     f"injected partial device loss at serve batch "

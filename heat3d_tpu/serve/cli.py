@@ -149,6 +149,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--bench", action="store_true",
                    help="measure one ensemble throughput row "
                    "(batch_shape/members_per_step provenance) and print it")
+    p.add_argument("--loadgen", default=None, metavar="SPEC.json",
+                   help="sustained-traffic soak: replay a seeded open-"
+                   "loop scenario-mix spec against the async engine "
+                   "(Poisson arrivals, ramps, bursts, per-stream "
+                   "admission control; serve/loadgen.py — docs/"
+                   "SERVING.md \"Load, overload & soak\"); with "
+                   "--verdict the machine-checked soak verdict prints "
+                   "to stdout, exit 0 only when it passes")
+    p.add_argument("--duration", type=float, default=None,
+                   help="(--loadgen) override the spec's duration_s")
+    p.add_argument("--row", default=None, metavar="FILE.jsonl",
+                   help="(--loadgen) append the soak's provenance row "
+                   "(bench=soak; check_provenance.py-checked) to this "
+                   "JSONL file")
     p.add_argument("--members", type=int, default=4,
                    help="(--bench) ensemble members")
     p.add_argument("--grid", type=int, default=32,
@@ -216,6 +230,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _main(args) -> int:
+    if args.loadgen:
+        if args.requests or args.smoke or args.bench:
+            raise ValueError(
+                "--loadgen is its own mode — it cannot combine with "
+                "--requests/--smoke/--bench"
+            )
+        return _serve_loadgen(args)
     if args.bench:
         from heat3d_tpu.core.config import GridConfig, SolverConfig
         from heat3d_tpu.serve.bench import bench_ensemble_throughput
@@ -347,6 +368,76 @@ def _main(args) -> int:
         if report["verdict"] == "breach":
             return 1
     return rc
+
+
+def _serve_loadgen(args) -> int:
+    """The sustained-traffic soak (serve/loadgen.py): seeded open-loop
+    replay against the async engine, SLO-judged, machine-verdicted.
+    rc 0 only when the soak's own checks pass AND no SLO objective
+    breached; rc 1 otherwise (the test-pinned contract)."""
+    import os
+
+    from heat3d_tpu.obs.perf import slo as slo_mod
+    from heat3d_tpu.serve import loadgen
+
+    with open(args.loadgen) as f:
+        try:
+            mix = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"{args.loadgen}: unparseable loadgen spec: {e}"
+            ) from None
+    if not isinstance(mix, dict):
+        raise ValueError(f"{args.loadgen}: loadgen spec must be an object")
+    if args.duration is not None:
+        mix["duration_s"] = args.duration
+
+    # SLO resolution, validated BEFORE the soak burns its duration:
+    # --slo / $HEAT3D_SLO_SPEC file > the mix's inline "slo" block > the
+    # soak default (generous latency, a real degraded budget)
+    if args.slo or os.environ.get("HEAT3D_SLO_SPEC"):
+        try:
+            slo_spec = slo_mod.load_spec(args.slo)
+        except OSError as e:
+            raise ValueError(f"--slo: {e}") from None
+    elif isinstance(mix.get("slo"), dict):
+        slo_spec = slo_mod.validate_spec(
+            dict(mix["slo"]), origin=f"{args.loadgen}: slo"
+        )
+    else:
+        slo_spec = dict(loadgen.DEFAULT_SOAK_SLO)
+
+    verdict = loadgen.run_soak(
+        mix, _base_from_record, _scenario_from_record
+    )
+    report = slo_mod.evaluate(
+        [], slo_spec,
+        serve_summary={**verdict["summary"], "source": "soak"},
+    )
+    slo_mod.record_verdict(report)
+    slo_mod.print_report(report, out=sys.stderr)
+    ok = verdict["ok"] and report["verdict"] != "breach"
+    if args.row:
+        row = loadgen.soak_row(verdict, report["verdict"])
+        with open(args.row, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        log.info("soak row appended to %s", args.row)
+    if args.verdict:
+        out = {k: v for k, v in verdict.items() if k != "summary"}
+        out["slo"] = report["verdict"]
+        out["ok"] = ok
+        print(json.dumps({"soak_verdict": out}), flush=True)
+    if not verdict["ok"]:
+        print(
+            "heat3d serve: soak failed its own checks "
+            f"(accounting_ok={verdict['accounting_ok']}, "
+            f"order_ok={verdict['order_ok']}, "
+            f"failed={verdict['failed']}, "
+            f"compile_stall_after_warmup="
+            f"{verdict['compile_stall_after_warmup']})",
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
 
 
 def _serve_sync(args, records):

@@ -8,9 +8,16 @@ axis needs (the GPU-aware-async-tasks paper's thesis: the scaling win is
 overlapping dispatch with in-flight work):
 
 - :meth:`AsyncServeEngine.submit` is thread-safe, returns immediately,
-  and applies the SAME explicit backpressure contract as the queue
-  (``HEAT3D_SERVE_QUEUE`` outstanding-request cap — raises, never
-  silently unbounded);
+  and applies explicit ADMISSION CONTROL (docs/SERVING.md "Load,
+  overload & soak"): the global ``HEAT3D_SERVE_QUEUE`` outstanding-
+  request cap bounds engine memory, and a per-stream cap
+  (``HEAT3D_SERVE_MAX_PER_STREAM``) bounds what any one stream/tenant
+  may hold open — a rejected submission raises a typed
+  :class:`~heat3d_tpu.serve.queue.Backpressure` carrying the per-stream
+  occupancy and lands a ``serve_shed`` ledger event, so shed traffic is
+  accounted, never silent. Packing interleaves streams round-robin
+  within each bucket, so a flooding stream can neither wedge the queue
+  against nor monopolize batch slots over a well-behaved one;
 - a **dispatcher thread** packs whatever is pending into shape-bucketed
   chunks (the queue's own bucketing/padding helpers) and hands each to
   its bucket's worker the moment that worker is free — continuous
@@ -22,7 +29,18 @@ overlapping dispatch with in-flight work):
   stall), execute one batch at a time, and block on the device futures
   (``gather`` / ``block_until_ready``) without stalling submission or
   other buckets. Total concurrent batches are capped by
-  ``HEAT3D_SERVE_WORKERS`` execution slots;
+  ``HEAT3D_SERVE_WORKERS`` execution slots — and the slot count SCALES
+  with load: the dispatcher grows it toward ``max_workers`` when the
+  pending backlog (sized in batches, weighted by the last measured
+  batch-execute time) outruns the current slots, and shrinks back to
+  the configured base when the queue drains, each move a
+  ``worker_scale`` ledger event;
+- **predictive AOT pre-warm**: the engine keeps a per-bucket arrival
+  history; :meth:`AsyncServeEngine.prewarm_forecast` (the load
+  generator calls it between arrivals) forecasts each hot bucket's
+  near-term batch size and warms that executable on the bucket's own
+  worker thread BEFORE traffic needs it (``aot_prewarm`` events —
+  the soak's zero-``compile_stall``-after-warmup criterion);
 - **delivery preserves submission order per request stream** (the
   ``stream`` tag at submit): within a stream, results yield strictly in
   submit order; across streams, a slow stream never blocks a fast one;
@@ -75,6 +93,7 @@ from heat3d_tpu.serve.queue import (
     DEFAULT_QUEUE_DEPTH,
     ENV_MAX_BATCH,
     ENV_QUEUE_DEPTH,
+    Backpressure,
     ServeResult,
     ServeStats,
     _env_int,
@@ -89,7 +108,15 @@ from heat3d_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 ENV_WORKERS = "HEAT3D_SERVE_WORKERS"
+ENV_MAX_PER_STREAM = "HEAT3D_SERVE_MAX_PER_STREAM"
 DEFAULT_WORKERS = 2
+# worker-slot scaling: how far past the configured base the dispatcher
+# may grow the execution slots, and the predicted-backlog-drain seconds
+# above which the latency leg adds a slot beyond the pure depth need
+DEFAULT_MAX_WORKERS_FACTOR = 4
+SCALE_LATENCY_S = 2.0
+# per-bucket arrival history (predictive prewarm): timestamps retained
+ARRIVAL_HISTORY_CAP = 256
 
 # Backend-loss requeue backoff (the ONE RetryPolicy implementation —
 # resilience/retry.py): attempts-capped, no deadline — a service must
@@ -138,16 +165,32 @@ class _Tracked:
     attempts: int = 0
 
 
+@dataclasses.dataclass
+class _Prewarm:
+    """A predictive warm-up work item: build (or AOT-load) the bucket's
+    executable for ``padded`` members on the bucket's OWN worker thread,
+    before traffic needs it. ``done`` lets a warmup phase wait for the
+    build without polling."""
+
+    base: SolverConfig
+    padded: int
+    forecast_members: int
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+
+
 class _BucketWorker(threading.Thread):
     """One bucket's executor: owns the bucket's solver cache (and its
     AOT warm-up) and runs one packed batch at a time off its own queue.
-    ``None`` is the shutdown sentinel."""
+    ``None`` is the shutdown sentinel; a :class:`_Prewarm` item builds
+    an executable without serving anything."""
 
     def __init__(self, engine: "AsyncServeEngine", bucket: str):
         super().__init__(name=f"heat3d-serve-{bucket[:24]}", daemon=True)
         self.engine = engine
         self.bucket = bucket
-        self.q: "stdqueue.Queue[Optional[List[_Tracked]]]" = stdqueue.Queue()
+        self.q: "stdqueue.Queue[Any]" = stdqueue.Queue()
         self.solvers: Dict[Tuple, EnsembleSolver] = {}
         self.start()
 
@@ -156,6 +199,9 @@ class _BucketWorker(threading.Thread):
             chunk = self.q.get()
             if chunk is None:
                 return
+            if isinstance(chunk, _Prewarm):
+                self.engine._do_prewarm(self, chunk)
+                continue
             # the global execution-slot cap (HEAT3D_SERVE_WORKERS): more
             # buckets than slots queue here rather than oversubscribing
             # the device
@@ -216,6 +262,8 @@ class AsyncServeEngine:
         max_depth: Optional[int] = None,
         batch_mesh: int = 1,
         workers: Optional[int] = None,
+        max_per_stream: Optional[int] = None,
+        max_workers: Optional[int] = None,
         snapshot_every: int = 0,
         with_residuals: bool = False,
         aot: Optional[bool] = None,
@@ -233,6 +281,23 @@ class AsyncServeEngine:
         self.snapshot_every = snapshot_every
         self.with_residuals = with_residuals
         self.workers = workers or _env_int(ENV_WORKERS, DEFAULT_WORKERS)
+        # per-stream admission cap: defaults to the global depth cap, so
+        # a single-stream caller sees EXACTLY the old behavior; soak /
+        # multi-tenant deployments set it lower to stop one stream from
+        # consuming the whole queue
+        self.max_per_stream = (
+            max_per_stream
+            or _env_int(ENV_MAX_PER_STREAM, 0)
+            or self.max_depth
+        )
+        # worker-slot scaling bounds: the semaphore starts at the
+        # configured base and the dispatcher moves it in
+        # [base, max_workers] as backlog demands
+        self.base_workers = self.workers
+        self.max_workers = max_workers or (
+            self.workers * DEFAULT_MAX_WORKERS_FACTOR
+        )
+        self.scale_latency_s = SCALE_LATENCY_S
         self._aot_dir = aot_dir
         # aot=None: enabled (serve/aot.py decides store-vs-measure-only
         # from HEAT3D_AOT_CACHE — an env-disabled store still warms with
@@ -266,9 +331,26 @@ class AsyncServeEngine:
         self._open = 0
         self._next_id = 0
         self._streams: Dict[str, List[int]] = {}
+        # admission-control bookkeeping: per-stream open counts (the cap
+        # the Backpressure error reports), shed totals, and the streams
+        # whose serve_admission event already landed
+        self._stream_open: Dict[str, int] = {}
+        self._stream_shed: Dict[str, int] = {}
+        self._shed = 0
+        self._admission_noted: set = set()
+        # predictive-prewarm state: per-bucket arrival timestamps (the
+        # forecast input), a representative base config per bucket (to
+        # build the dummy warm batch), and the (bucket, padded) sizes
+        # already warm — whether by prewarm or by live traffic
+        self._arrival_history: Dict[str, List[float]] = {}
+        self._bucket_base: Dict[str, SolverConfig] = {}
+        self._prewarmed: set = set()
         self._workers: Dict[str, _BucketWorker] = {}
         self._busy: set = set()
         self._slots = threading.Semaphore(self.workers)
+        self._slot_count = self.workers
+        self._scale_events = 0
+        self._last_execute_s = 0.0
         self._stop = False
         self._joined = False
         self._stats = ServeStats()
@@ -282,7 +364,8 @@ class AsyncServeEngine:
         self._cancelled = 0
         self._aot_stats = {
             "hits": 0, "misses": 0, "stale": 0, "disabled": 0,
-            "exports": 0, "compile_stall_s": 0.0, "load_s": 0.0,
+            "exports": 0, "stalls": 0,
+            "compile_stall_s": 0.0, "load_s": 0.0,
         }
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="heat3d-serve-dispatch",
@@ -328,45 +411,108 @@ class AsyncServeEngine:
         stream: str = "",
     ) -> int:
         """Enqueue one scenario; returns the request id. Thread-safe and
-        non-blocking: batches already in flight keep flying. Raises when
+        non-blocking: batches already in flight keep flying. Raises a
+        typed :class:`~heat3d_tpu.serve.queue.Backpressure` (carrying
+        per-stream occupancy, with a ``serve_shed`` ledger event) when
         the engine holds ``HEAT3D_SERVE_QUEUE`` requests (pending +
         in-flight + completed-but-undelivered — the cap bounds engine
         MEMORY, so a slow results() consumer backpressures submitters)
-        — or after :meth:`shutdown`."""
+        or when this ``stream`` already holds
+        ``HEAT3D_SERVE_MAX_PER_STREAM`` open requests — and a plain
+        RuntimeError after :meth:`shutdown`."""
         if scenario.steps is None:
             # materialize the budget at SUBMIT time (the queue's rule):
             # budgets are traced inputs, not bucket structure, so a
             # default-budget scenario must not inherit another base's
             # step count at packing time
             scenario = dataclasses.replace(scenario, steps=base.run.num_steps)
+        shed: Optional[Backpressure] = None
+        shed_reason = ""
+        first_on_stream = False
         with self._cond:
             if self._stop:
                 raise RuntimeError(
                     "engine is shut down — no further submissions"
                 )
+            s_open = self._stream_open.get(stream, 0)
             if self._open >= self.max_depth:
-                raise RuntimeError(
+                shed_reason = "depth"
+                shed = Backpressure(
                     f"serve queue full ({self.max_depth} outstanding; "
                     f"{ENV_QUEUE_DEPTH} raises the cap) — wait for "
-                    "deliveries before submitting more"
+                    "deliveries before submitting more",
+                    depth=self._open, max_depth=self.max_depth,
+                    stream=stream, stream_depth=s_open,
+                    stream_cap=self.max_per_stream,
+                    per_stream=dict(self._stream_open),
                 )
-            rid = self._next_id
-            self._next_id += 1
-            self._open += 1
-            self._req[rid] = _Tracked(
-                request_id=rid,
-                base=base,
-                scenario=scenario,
-                stream=stream,
-                submitted_at=time.monotonic(),
-            )
-            self._streams.setdefault(stream, []).append(rid)
-            if self._in_flight > 0:
-                # the overlap the engine exists for: this submission was
-                # accepted while a batch executed (test-pinned)
-                self._accepted_in_flight += 1
-            depth = self._open
+            elif s_open >= self.max_per_stream:
+                shed_reason = "stream_cap"
+                shed = Backpressure(
+                    f"stream {stream or '(default)'} at its admission "
+                    f"cap ({s_open} open; {ENV_MAX_PER_STREAM} raises "
+                    "it) — other streams keep flowing",
+                    depth=self._open, max_depth=self.max_depth,
+                    stream=stream, stream_depth=s_open,
+                    stream_cap=self.max_per_stream,
+                    per_stream=dict(self._stream_open),
+                )
+            if shed is not None:
+                # shed accounting: the rejection is explicit state, not
+                # just an exception — admitted + shed == submitted is
+                # the soak's conservation law
+                self._shed += 1
+                self._stream_shed[stream] = (
+                    self._stream_shed.get(stream, 0) + 1
+                )
+            else:
+                rid = self._next_id
+                self._next_id += 1
+                self._open += 1
+                self._stream_open[stream] = s_open + 1
+                first_on_stream = stream not in self._admission_noted
+                self._admission_noted.add(stream)
+                self._req[rid] = _Tracked(
+                    request_id=rid,
+                    base=base,
+                    scenario=scenario,
+                    stream=stream,
+                    submitted_at=time.monotonic(),
+                )
+                self._streams.setdefault(stream, []).append(rid)
+                bucket = str(solver_bucket_key(base))
+                self._bucket_base.setdefault(bucket, base)
+                hist = self._arrival_history.setdefault(bucket, [])
+                hist.append(time.monotonic())
+                if len(hist) > ARRIVAL_HISTORY_CAP:
+                    del hist[: len(hist) - ARRIVAL_HISTORY_CAP]
+                if self._in_flight > 0:
+                    # the overlap the engine exists for: this submission
+                    # was accepted while a batch executed (test-pinned)
+                    self._accepted_in_flight += 1
+                depth = self._open
             self._cond.notify_all()
+        if shed is not None:
+            obs.get().event(
+                "serve_shed",
+                stream=stream or None,
+                reason=shed_reason,
+                depth=shed.depth,
+                max_depth=shed.max_depth,
+                stream_depth=shed.stream_depth,
+                stream_cap=shed.stream_cap,
+                per_stream={
+                    (k or "(default)"): v for k, v in shed.per_stream.items()
+                },
+            )
+            raise shed
+        if first_on_stream:
+            obs.get().event(
+                "serve_admission",
+                stream=stream or None,
+                stream_cap=self.max_per_stream,
+                max_depth=self.max_depth,
+            )
         self._stats.observe_depth(depth)
         obs.get().event(
             "serve_submit",
@@ -392,8 +538,19 @@ class AsyncServeEngine:
             r.state = _CANCELLED
             self._cancelled += 1
             self._open -= 1
+            self._release_stream(r.stream)
             self._cond.notify_all()
             return True
+
+    def _release_stream(self, stream: str) -> None:
+        """Under the lock: one open request on ``stream`` left the
+        engine (delivered, failed, or cancelled) — free its admission
+        slot."""
+        n = self._stream_open.get(stream, 0) - 1
+        if n > 0:
+            self._stream_open[stream] = n
+        else:
+            self._stream_open.pop(stream, None)
 
     # ---- the dispatcher loop ----------------------------------------------
 
@@ -401,8 +558,14 @@ class AsyncServeEngine:
         return [r for r in self._req.values() if r.state == _PENDING]
 
     def _pack(self) -> List[Tuple[_BucketWorker, List[_Tracked]]]:
-        """Under the lock: one chunk per idle-bucket, submission order
-        preserved inside each bucket (the packing rule the queue uses)."""
+        """Under the lock: one chunk per idle-bucket. Within a bucket,
+        streams share batch slots ROUND-ROBIN (each stream's own
+        requests stay in submission order — delivery order needs that),
+        so a flooding stream cannot monopolize a batch over a
+        well-behaved one. A single stream degenerates to exactly the
+        old take-the-first-``max_batch`` packing, which keeps batch
+        composition — and the AOT store's padded-size keys —
+        deterministic for the single-stream acceptance runs."""
         by_bucket: Dict[str, List[_Tracked]] = {}
         for r in self._undispatched():
             by_bucket.setdefault(str(solver_bucket_key(r.base)), []).append(r)
@@ -416,23 +579,86 @@ class AsyncServeEngine:
             if worker is None:
                 worker = _BucketWorker(self, bucket)
                 self._workers[bucket] = worker
-            chunk = reqs[: self.max_batch]
+            lanes: Dict[str, List[_Tracked]] = {}
+            for r in reqs:  # reqs are in submission order already
+                lanes.setdefault(r.stream, []).append(r)
+            chunk: List[_Tracked] = []
+            while len(chunk) < self.max_batch and lanes:
+                for stream in list(lanes):
+                    chunk.append(lanes[stream].pop(0))
+                    if not lanes[stream]:
+                        del lanes[stream]
+                    if len(chunk) >= self.max_batch:
+                        break
             for r in chunk:
                 r.state = _DISPATCHED
             self._busy.add(bucket)
             out.append((worker, chunk))
         return out
 
+    def _maybe_scale(self) -> Optional[Dict[str, Any]]:
+        """Under the lock: move the execution-slot count toward what the
+        backlog needs — grow toward ``max_workers`` when more batches
+        are waiting than slots can fly (the latency leg adds one more
+        when the predicted drain time, backlog-batches x the last
+        measured execute time, exceeds ``scale_latency_s``), shrink back
+        to the configured base once the queue is empty and nothing
+        flies. Returns the ``worker_scale`` event payload (emitted by
+        the caller OUTSIDE the lock) or None when the count stands."""
+        backlog = sum(1 for r in self._req.values() if r.state == _PENDING)
+        need = -(-backlog // self.max_batch) if backlog else 0  # ceil
+        if (
+            backlog
+            and self._last_execute_s > 0
+            and need * self._last_execute_s > self.scale_latency_s
+        ):
+            need += 1
+        desired = min(self.max_workers, max(self.base_workers, need))
+        if backlog == 0 and self._in_flight == 0:
+            desired = self.base_workers
+        elif desired < self._slot_count:
+            # never shrink while loaded: reclaiming a slot can only block
+            # on an acquire the backlog is about to need
+            return None
+        if desired == self._slot_count:
+            return None
+        before = self._slot_count
+        if desired > self._slot_count:
+            for _ in range(desired - self._slot_count):
+                self._slots.release()
+            self._slot_count = desired
+        else:
+            # reclaim only idle slots (non-blocking): a slot held by an
+            # in-flight batch is returned by its worker and reclaimed on
+            # a later pass
+            while self._slot_count > desired and self._slots.acquire(
+                blocking=False
+            ):
+                self._slot_count -= 1
+            if self._slot_count == before:
+                return None
+        self._scale_events += 1
+        return {
+            "direction": "up" if self._slot_count > before else "down",
+            "slots_from": before,
+            "slots_to": self._slot_count,
+            "backlog": backlog,
+            "last_execute_s": round(self._last_execute_s, 6),
+        }
+
     def _dispatch_loop(self) -> None:
         while True:
             with self._cond:
                 while True:
+                    scale = self._maybe_scale()
                     assignments = self._pack()
-                    if assignments:
+                    if assignments or scale:
                         break
                     if self._stop and not self._undispatched():
                         return
                     self._cond.wait()
+            if scale:
+                obs.get().event("worker_scale", **scale)
             for worker, chunk in assignments:
                 obs.get().event(
                     "serve_dispatch",
@@ -501,6 +727,13 @@ class AsyncServeEngine:
                 execute_s=round(span.dur_s or 0.0, 6),
                 in_flight=self._in_flight,
             )
+            with self._cond:
+                # the scaling signal: how long the LAST batch took to
+                # execute weights the backlog into a drain-time estimate
+                self._last_execute_s = span.dur_s or 0.0
+                # live traffic built this executable: the padded size is
+                # warm now — prewarm must not rebuild it
+                self._prewarmed.add((bucket_s, padded))
         except BaseException as e:  # noqa: BLE001 - fail THIS chunk only
             if self._maybe_requeue(worker, chunk, e):
                 return
@@ -602,6 +835,7 @@ class AsyncServeEngine:
                 r.state = _FAILED
                 r.error = err
                 self._open -= 1
+                self._release_stream(r.stream)
                 rec = {
                     "request_id": r.request_id,
                     "stream": r.stream,
@@ -627,9 +861,112 @@ class AsyncServeEngine:
             if report.get("exported"):
                 st["exports"] += 1
             if report.get("compile_stall_s"):
+                st["stalls"] += 1
                 st["compile_stall_s"] += float(report["compile_stall_s"])
             if report.get("load_s"):
                 st["load_s"] += float(report["load_s"])
+
+    # ---- predictive AOT pre-warm -------------------------------------------
+
+    def _do_prewarm(self, worker: _BucketWorker, item: _Prewarm) -> None:
+        """In the worker thread: build (or AOT-load) the executable for
+        ``item.padded`` members with a dummy member batch. The solver
+        cache key is member-INDEPENDENT (bucket, padded, batch_mesh), so
+        the first real request of that shape rebinds coefficients on the
+        prewarmed programs instead of tracing. Fail-soft: a prewarm
+        failure only costs the prediction — live traffic still builds on
+        demand."""
+        t0 = time.monotonic()
+        try:
+            dummy = [
+                Scenario(steps=item.base.run.num_steps)
+            ] * min(item.forecast_members, item.padded)
+            batch = pad_batch(item.base, dummy, item.padded)
+            worker.solver_for(batch, item.padded)
+            obs.get().event(
+                "aot_prewarm",
+                bucket=worker.bucket,
+                padded=item.padded,
+                forecast_members=item.forecast_members,
+                seconds=round(time.monotonic() - t0, 6),
+            )
+        except BaseException as e:  # noqa: BLE001 - prediction only
+            log.warning(
+                "prewarm failed for bucket %s padded=%d: %s",
+                worker.bucket, item.padded, e,
+            )
+            with self._cond:
+                self._prewarmed.discard((worker.bucket, item.padded))
+        finally:
+            item.done.set()
+
+    def prewarm(
+        self,
+        base: SolverConfig,
+        expected_members: int = 1,
+        forecast: Optional[int] = None,
+    ) -> Optional[threading.Event]:
+        """Queue a warm-up of ``base``'s bucket for ``expected_members``
+        (padded to the executable size traffic of that count would use)
+        on the bucket's own worker. Returns an Event that sets when the
+        build finishes, or None when that (bucket, padded) is already
+        warm. Thread-safe; never blocks on the build itself."""
+        bucket = str(solver_bucket_key(base))
+        padded = _padded_size(
+            max(1, expected_members), self.max_batch, self.batch_mesh
+        )
+        with self._cond:
+            if self._stop:
+                return None
+            key = (bucket, padded)
+            if key in self._prewarmed:
+                return None
+            self._prewarmed.add(key)
+            worker = self._workers.get(bucket)
+            if worker is None:
+                worker = _BucketWorker(self, bucket)
+                self._workers[bucket] = worker
+        item = _Prewarm(
+            base=base, padded=padded,
+            forecast_members=forecast or expected_members,
+        )
+        worker.q.put(item)
+        return item.done
+
+    def prewarm_forecast(
+        self,
+        horizon_s: float = 5.0,
+        window_s: float = 30.0,
+        max_buckets: int = 4,
+    ) -> List[threading.Event]:
+        """Forecast each hot bucket's near-term batch size from its
+        arrival history (arrivals in the trailing ``window_s``, scaled
+        to ``horizon_s``) and queue prewarms for the executables that
+        forecast implies. The load generator calls this between
+        arrivals; each build emits ``aot_prewarm``. Returns the pending
+        build Events (already-warm forecasts return nothing)."""
+        now = time.monotonic()
+        plans: List[Tuple[SolverConfig, int]] = []
+        with self._cond:
+            rates = []
+            for bucket, hist in self._arrival_history.items():
+                recent = [t for t in hist if now - t <= window_s]
+                if not recent:
+                    continue
+                rates.append((len(recent), bucket))
+            rates.sort(reverse=True)
+            for n, bucket in rates[:max_buckets]:
+                base = self._bucket_base.get(bucket)
+                if base is None:
+                    continue
+                expect = max(1, int(n * horizon_s / window_s))
+                plans.append((base, min(expect, self.max_batch)))
+        events = []
+        for base, expect in plans:
+            ev = self.prewarm(base, expected_members=expect, forecast=expect)
+            if ev is not None:
+                events.append(ev)
+        return events
 
     # ---- delivery ----------------------------------------------------------
 
@@ -656,6 +993,7 @@ class AsyncServeEngine:
                 if r.state == _DONE:
                     res = r.result
                     self._open -= 1
+                    self._release_stream(stream)
                     i += 1
                 break
             if i:
@@ -746,13 +1084,28 @@ class AsyncServeEngine:
         proof), and the AOT warm-up aggregate."""
         with self._cond:
             return {
-                "submitted": self._next_id,
+                # submitted = every submit() ATTEMPT; admitted + shed ==
+                # submitted is the soak verdict's conservation law
+                "submitted": self._next_id + self._shed,
+                "admitted": self._next_id,
+                "shed": self._shed,
+                "shed_by_stream": {
+                    (k or "(default)"): v
+                    for k, v in self._stream_shed.items()
+                },
                 "delivered": self._stats.delivered,
                 "failed": len(self.failures),
                 "cancelled": self._cancelled,
                 "batches": self._stats.batches,
                 "buckets": len(self._workers),
                 "workers": self.workers,
+                "slots": self._slot_count,
+                "scale_events": self._scale_events,
+                "prewarmed": len(self._prewarmed),
+                "streams": {
+                    (k or "(default)"): v
+                    for k, v in self._stream_open.items()
+                },
                 "max_in_flight": self._max_in_flight,
                 "accepted_in_flight": self._accepted_in_flight,
                 "requeues": self._stats.requeues,
@@ -778,6 +1131,7 @@ class AsyncServeEngine:
                     r.state = _CANCELLED
                     self._cancelled += 1
                     self._open -= 1
+                    self._release_stream(r.stream)
             self._cond.notify_all()
         if wait:
             self._dispatcher.join()

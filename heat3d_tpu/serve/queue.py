@@ -49,6 +49,42 @@ DEFAULT_QUEUE_DEPTH = 1024
 DEFAULT_MAX_BATCH = 64
 
 
+class Backpressure(RuntimeError):
+    """Typed admission-control rejection (docs/SERVING.md "Load,
+    overload & soak"): submit() refused a request, and the exception
+    carries the occupancy state a caller needs to ACT — back off, shed
+    to another replica, retry after deliveries — instead of parsing a
+    message. Subclasses RuntimeError so pre-existing submit() error
+    handling keeps working unchanged.
+
+    ``depth``/``max_depth`` are the global occupancy and cap at the
+    rejection; ``stream``/``stream_depth``/``stream_cap`` identify a
+    PER-STREAM rejection (``stream_cap`` is None when the global depth
+    cap rejected); ``per_stream`` maps every live stream tag to the
+    requests it still holds open — the whole point: the caller can see
+    WHO is occupying the queue, not just that it is full.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        depth: int,
+        max_depth: int,
+        stream: Optional[str] = None,
+        stream_depth: Optional[int] = None,
+        stream_cap: Optional[int] = None,
+        per_stream: Optional[Dict[str, int]] = None,
+    ):
+        super().__init__(message)
+        self.depth = depth
+        self.max_depth = max_depth
+        self.stream = stream
+        self.stream_depth = stream_depth
+        self.stream_cap = stream_cap
+        self.per_stream = dict(per_stream or {})
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         v = int(os.environ.get(name, ""))
@@ -401,14 +437,28 @@ class ScenarioQueue:
 
     def submit(self, base: SolverConfig, scenario: Scenario) -> int:
         """Enqueue one scenario over structural config ``base``; returns
-        the request id results are keyed by. Raises when the queue is at
-        ``HEAT3D_SERVE_QUEUE`` depth (backpressure must be explicit — a
-        silently unbounded queue is how a service dies)."""
+        the request id results are keyed by. Raises :class:`Backpressure`
+        (a RuntimeError carrying the occupancy) when the queue is at
+        ``HEAT3D_SERVE_QUEUE`` depth — backpressure must be explicit AND
+        actionable; a silently unbounded queue is how a service dies,
+        and a bare depth error gives the caller nothing to act on. The
+        rejection also lands a ``serve_shed`` ledger event so shed
+        traffic is accounted, never invisible."""
         if len(self._pending) >= self.max_depth:
-            raise RuntimeError(
+            obs.get().event(
+                "serve_shed",
+                stream=None,
+                reason="depth",
+                depth=len(self._pending),
+                max_depth=self.max_depth,
+            )
+            raise Backpressure(
                 f"serve queue full ({self.max_depth} pending; "
                 f"{ENV_QUEUE_DEPTH} raises the cap) — drain before "
-                "submitting more"
+                "submitting more",
+                depth=len(self._pending),
+                max_depth=self.max_depth,
+                per_stream={"": len(self._pending)},
             )
         if scenario.steps is None:
             # materialize the budget NOW: num_steps is not part of the

@@ -20,7 +20,14 @@ class of gap loud at measurement time instead of at judging time: it FAILS
 - is a ``time_blocking > 1`` throughput row missing a numeric
   ``cost_redundant_flops_frac`` (deep-tb recompute honesty), or
 - is a halo row missing ``platform``, or
-- is a bench row (either kind) missing a numeric ``sync_rtt_s``.
+- is a ``weak_scaling`` row missing ``platform``, the judged
+  ``gcell_per_sec_per_chip``, or its ``post_heal`` elastic provenance, or
+- is a ``soak`` row (serve/loadgen.py verdict rows) missing
+  ``platform``/``duration_s``/``seed``, violating the conservation law
+  ``admitted + shed == submitted``, or missing the judged
+  ``sustained_member_gcell_per_s``, ``degraded_s`` chaos provenance, or
+  the ``slo`` verdict that judged it, or
+- is a throughput/halo row missing a numeric ``sync_rtt_s``.
 
 Wired into the bench report path (scripts/run_bench_suite.sh runs it after
 regenerating BASELINE.md, and its rc is the suite's rc), so a session

@@ -468,3 +468,71 @@ PYEOF
 else
   note "suite: elastic smoke skipped (SKIP_ELASTIC_SMOKE=1)"
 fi
+
+# Sustained-soak smoke (informational; docs/SERVING.md "Load, overload &
+# soak"): a seeded ~60s open-loop soak on a forced 4-device CPU mesh with
+# a partial device loss injected mid-run — per-stream admission, fair
+# packing, the pre-warm ladder, the requeue path and the machine-checked
+# verdict all exercised in one bounded pass. The soak_smoke JSON line
+# carries the conservation law (admitted + shed == submitted via the
+# verdict's ok), the degraded window, and the zero-post-warmup-compile-
+# stall criterion. Always CPU (the path under test is overload control,
+# not the chip). Fails SOFT; SKIP_SOAK_SMOKE=1 skips.
+if [[ -z "${SKIP_SOAK_SMOKE:-}" ]]; then
+  SOAK_MIX="${OUT%.jsonl}.soak_mix.json"
+  SOAK_AOT="${OUT%.jsonl}.soak_aot"
+  rm -rf "$SOAK_AOT"
+  cat > "$SOAK_MIX" <<'JSONEOF'
+{
+  "duration_s": 60,
+  "seed": 42,
+  "ramp": {"kind": "diurnal", "period_s": 30, "min_frac": 0.5},
+  "engine": {"max_batch": 2, "max_per_stream": 4, "workers": 1},
+  "streams": [
+    {"name": "tenant-a", "rate_hz": 2.0,
+     "scenarios": [
+       {"grid": 16, "steps": 4, "alpha": 0.5, "seed": 1,
+        "mesh": [4, 1, 1]},
+       {"grid": 16, "steps": 3, "alpha": 0.8, "init": "gaussian",
+        "seed": 2, "mesh": [4, 1, 1]}
+     ]},
+    {"name": "flood", "rate_hz": 4.0,
+     "burst": {"every_s": 10, "len_s": 3, "multiplier": 5},
+     "scenarios": [
+       {"grid": 24, "steps": 20, "alpha": 0.3, "seed": 3,
+        "mesh": [4, 1, 1]}
+     ]}
+  ]
+}
+JSONEOF
+  SOAK_LINE=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    HEAT3D_FAULTS="partial-device-loss:after=20:keep=2" \
+    HEAT3D_AOT_CACHE="$SOAK_AOT" \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
+    python -m heat3d_tpu.cli serve --loadgen "$SOAK_MIX" \
+    --duration "${SOAK_DURATION:-60}" --verdict \
+    2>>"$SUITE_LOG" | tail -n 1) \
+    || note "suite: soak smoke run failed (rc=$?) — informational"
+  python - "$SOAK_LINE" <<'PYEOF' \
+    || note "suite: soak smoke verdict failed — informational"
+import json, sys
+try:
+    v = json.loads(sys.argv[1])["soak_verdict"]
+except Exception:
+    print(json.dumps({"soak_smoke": {"ok": False, "error": "no verdict"}}))
+    sys.exit(1)
+ok = bool(v.get("ok")) and v.get("slo") == "pass"
+print(json.dumps({"soak_smoke": {
+    "ok": ok, "arrivals": v.get("arrivals"),
+    "submitted": v.get("submitted"), "admitted": v.get("admitted"),
+    "shed": v.get("shed"), "requeues": v.get("requeues"),
+    "degraded_s": v.get("degraded_s"),
+    "compile_stall_after_warmup": v.get("compile_stall_after_warmup"),
+    "sustained_member_gcell_per_s": v.get("sustained_member_gcell_per_s"),
+    "slo": v.get("slo")}}))
+sys.exit(0 if ok else 1)
+PYEOF
+else
+  note "suite: soak smoke skipped (SKIP_SOAK_SMOKE=1)"
+fi

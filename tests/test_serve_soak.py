@@ -1,0 +1,238 @@
+"""Sustained-traffic soak: open-loop load generation, adaptive overload
+control, chaos-hardened serving (heat3d_tpu/serve/loadgen.py, the
+admission/fairness/scaling layer in heat3d_tpu/serve/engine/core.py;
+docs/SERVING.md "Load, overload & soak").
+
+Acceptance battery for ISSUE 16. Tiers:
+
+- in-process (1 device, no solver work): arrival-schedule determinism
+  (same seed → identical schedule; per-stream seeding so adding a
+  stream never perturbs another's), diurnal/burst shaping, scenario-mix
+  validation errors, the default soak SLO's validity, the typed
+  ``Backpressure`` payload, and the soak row's provenance shape;
+- subprocess (REAL 4-device CPU mesh, tests/soak_checks.py): per-stream
+  admission control — a flooding stream shed with typed per-stream
+  occupancy while a well-behaved concurrent stream delivers in order,
+  BYTE-IDENTICAL to an unloaded run — and the full seeded soak with a
+  mid-run ``partial-device-loss`` (verdict accounting, degraded window
+  judged with data, zero post-warmup compile stalls, rc 0 pass /
+  rc 1 breach, committed row passing the provenance lint).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from heat3d_tpu.serve import loadgen
+from heat3d_tpu.serve.queue import Backpressure
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_stores(tmp_path, monkeypatch):
+    """Every test gets its own AOT store and tune cache — a developer's
+    ~/.cache must never leak into (or be polluted by) the suite."""
+    monkeypatch.setenv("HEAT3D_AOT_CACHE", str(tmp_path / "aot"))
+    monkeypatch.setenv("HEAT3D_TUNE_CACHE", str(tmp_path / "tune.json"))
+    yield
+
+
+def _mix(**over):
+    mix = {
+        "duration_s": 30,
+        "seed": 7,
+        "ramp": {"kind": "diurnal", "period_s": 30, "min_frac": 0.25},
+        "streams": [
+            {"name": "a", "rate_hz": 3.0,
+             "scenarios": [{"grid": 8, "steps": 2}]},
+            {"name": "b", "rate_hz": 1.0,
+             "burst": {"every_s": 10, "len_s": 2, "multiplier": 6},
+             "scenarios": [{"grid": 8, "steps": 2}, {"grid": 8, "steps": 3}]},
+        ],
+    }
+    mix.update(over)
+    return mix
+
+
+# ---- arrival schedule (pure, no devices) ------------------------------------
+
+
+def test_arrivals_deterministic_for_seed():
+    """The replayability contract: the whole soak schedule is a pure
+    function of (spec, seed) — HEAT3D_LOADGEN_SEED supplies the seed
+    when the spec doesn't pin one."""
+    a1 = loadgen.generate_arrivals(_mix())
+    a2 = loadgen.generate_arrivals(_mix())
+    assert a1 == a2 and a1, "same seed must replay the exact schedule"
+    assert a1 != loadgen.generate_arrivals(_mix(seed=8))
+
+    unseeded = _mix()
+    del unseeded["seed"]
+    os.environ[loadgen.ENV_LOADGEN_SEED] = "7"
+    try:
+        assert loadgen.generate_arrivals(unseeded) == a1
+    finally:
+        del os.environ[loadgen.ENV_LOADGEN_SEED]
+
+    for a in a1:
+        assert 0 <= a.t < 30 and a.stream in ("a", "b")
+    assert [a.t for a in a1] == sorted(a.t for a in a1)
+
+
+def test_arrivals_per_stream_seeding_is_independent():
+    """Adding a stream must not perturb existing schedules (each stream
+    draws from Random(f"{seed}:{name}"))."""
+    solo = [a for a in loadgen.generate_arrivals(_mix()) if a.stream == "a"]
+    mix3 = _mix()
+    mix3["streams"].append(
+        {"name": "c", "rate_hz": 9.0, "scenarios": [{"grid": 8}]}
+    )
+    both = [a for a in loadgen.generate_arrivals(mix3) if a.stream == "a"]
+    assert solo == both
+
+
+def test_diurnal_and_burst_shaping():
+    ramp = {"kind": "diurnal", "period_s": 100, "min_frac": 0.25}
+    assert loadgen._rate_factor(0.0, ramp, 100) == pytest.approx(0.25)
+    assert loadgen._rate_factor(50.0, ramp, 100) == pytest.approx(1.0)
+    assert loadgen._rate_factor(0.0, None, 100) == 1.0
+
+    burst = {"every_s": 10, "len_s": 2, "multiplier": 6}
+    assert loadgen._burst_factor(0.5, burst) == 6.0
+    assert loadgen._burst_factor(5.0, burst) == 1.0
+    assert loadgen._burst_factor(11.9, burst) == 6.0
+
+    # the bursty stream really is denser inside its windows
+    arr = loadgen.generate_arrivals(_mix(duration_s=100, ramp=None))
+    b = [a.t for a in arr if a.stream == "b"]
+    in_burst = sum(1 for t in b if t % 10 < 2)
+    assert in_burst > len(b) - in_burst, (in_burst, len(b))
+
+
+@pytest.mark.parametrize(
+    "mutate, needle",
+    [
+        (lambda m: m.update(bogus=1), "unknown key"),
+        (lambda m: m.pop("duration_s"), "duration_s"),
+        (lambda m: m["streams"][1].update(name="a"), "duplicate stream"),
+        (lambda m: m["streams"][0].update(scenarios=[]), "scenarios"),
+        (lambda m: m["streams"][1]["burst"].pop("len_s"), "burst.len_s"),
+        (lambda m: m.update(ramp={"kind": "square"}), "ramp.kind"),
+        (lambda m: m["streams"][0].update(rate_hz=-1), "rate_hz"),
+    ],
+)
+def test_validate_mix_names_the_field_at_fault(mutate, needle):
+    mix = _mix()
+    mutate(mix)
+    with pytest.raises(ValueError, match=needle):
+        loadgen.validate_mix(mix)
+
+
+def test_default_soak_slo_is_a_valid_spec():
+    """The zero-config soak judges against a REAL spec: it must pass the
+    same validator user specs do and cover the degraded objective."""
+    from heat3d_tpu.obs.perf import slo
+
+    spec = slo.validate_spec(dict(loadgen.DEFAULT_SOAK_SLO), origin="default")
+    kinds = {o["kind"] for o in spec["objectives"]}
+    assert "serve_degraded" in kinds and "serve_latency" in kinds
+
+
+def test_backpressure_payload_is_typed():
+    e = Backpressure(
+        "serve queue full", depth=4, max_depth=4, stream="x",
+        stream_depth=2, stream_cap=2, per_stream={"x": 2, "y": 2},
+    )
+    assert isinstance(e, RuntimeError)  # legacy "queue full" catchers
+    assert (e.depth, e.max_depth) == (4, 4)
+    assert e.per_stream == {"x": 2, "y": 2}
+    assert e.stream == "x" and e.stream_cap == 2
+
+
+def test_soak_row_passes_provenance_lint():
+    from heat3d_tpu.analysis.provenance import check_row
+
+    verdict = {
+        "seed": 7, "duration_s": 8.0, "arrivals": 20, "submitted": 20,
+        "admitted": 18, "shed": 2, "delivered": 18, "failed": 0,
+        "requeues": 1, "degraded_s": 0.4, "batches": 9, "scale_events": 1,
+        "warmup_s": 1.2, "compile_stall_after_warmup": 0,
+        "sustained_member_gcell_per_s": 0.05,
+        "per_bucket": {}, "ok": True,
+    }
+    row = loadgen.soak_row(verdict, "pass", ts="2026-08-06T00:00:00Z")
+    assert check_row(row) == []
+
+    # the conservation law is ENFORCED by the lint, not just recorded
+    row_bad = dict(row, admitted=17)
+    assert any("conservation" in p for p in check_row(row_bad))
+
+
+# ---- the 4-device CPU-mesh acceptance --------------------------------------
+
+
+def _subproc_env(tmp_path=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE), env.get("PYTHONPATH", "")]
+    )
+    if tmp_path is not None:
+        env["HEAT3D_AOT_CACHE"] = str(tmp_path / "aot")
+    else:
+        env["HEAT3D_AOT_CACHE"] = "0"
+    return env
+
+
+def test_admission_fairness_on_cpu_mesh_tier1():
+    """THE fairness acceptance (ISSUE 16): on a REAL 4-device CPU mesh a
+    flooding stream is shed at its per-stream cap (typed Backpressure
+    carrying every stream's occupancy, shed fully accounted) while a
+    well-behaved concurrent stream's results arrive in submission order
+    with fields byte-identical to an unloaded ScenarioQueue run."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "soak_checks.py")],
+        env=_subproc_env(),
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"admission battery failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )
+    assert "SOAK ADMISSION OK" in proc.stdout
+
+
+@pytest.mark.parametrize("stage", ["soak-pass", "soak-breach"])
+def test_short_soak_with_midrun_device_loss_tier1(stage, tmp_path):
+    """THE soak acceptance (ISSUE 16): a seeded 8s soak in a fresh
+    process with a partial device loss injected 3s in — every admitted
+    stream delivered in order, admitted + shed == submitted, the
+    degraded window judged by serve_degraded WITH data, zero compile
+    stalls after warmup, and the CLI verdict exits 0 on pass (row
+    passing the provenance lint) / 1 on an impossible inline SLO."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(HERE, "soak_checks.py"),
+            stage,
+            str(tmp_path),
+        ],
+        env=_subproc_env(tmp_path),
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"{stage} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "SOAK STAGE OK" in proc.stdout
