@@ -143,37 +143,51 @@ def check_file(
     nesting cross the boundary): APPEND-mode suite sessions lint only the
     segments THEY wrote, the same rule check_provenance.py applies to
     bench rows, so one historical defect cannot keep every resumed
-    session permanently red."""
+    session permanently red.
+
+    Rotated ledgers (``HEAT3D_LEDGER_MAX_MB`` rollover, oldest segment
+    ``<stem>.0.jsonl``) are linted as ONE stream: given the base path, the
+    rolled siblings are read first and line numbers continue across the
+    concatenation — the writer's (run_id, proc, seq) stream spans the
+    segments, so seq chains and the leading ledger_open only hold on the
+    whole. Lint a rolled segment via its base path, not directly."""
+    from heat3d_tpu.obs.ledger import ledger_segments
+
     bad: List[Defect] = []
     streams: Dict[Tuple[str, int], List[Tuple[int, Dict[str, Any]]]] = (
         defaultdict(list)
     )
-    try:
-        f = open(path)
-    except OSError as e:
-        return [(0, f"cannot open {path}: {e}")]
-    with f:
-        for i, line in enumerate(f, start=1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                bad.append((i, "unparseable JSON"))
-                continue
-            if not isinstance(rec, dict):
-                bad.append((i, "event is not a JSON object"))
-                continue
-            for p in _check_event(rec):
-                bad.append((i, p))
-            if taxonomy:
-                for p in _check_taxonomy(rec):
+    i = 0
+    for seg in ledger_segments(path):
+        try:
+            f = open(seg)
+        except OSError as e:
+            if seg == path:
+                return [(0, f"cannot open {path}: {e}")]
+            continue  # rolled sibling raced away: lint what remains
+        with f:
+            for line in f:
+                i += 1
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    bad.append((i, "unparseable JSON"))
+                    continue
+                if not isinstance(rec, dict):
+                    bad.append((i, "event is not a JSON object"))
+                    continue
+                for p in _check_event(rec):
                     bad.append((i, p))
-            if isinstance(rec.get("run_id"), str) and isinstance(
-                rec.get("proc"), int
-            ):
-                streams[(rec["run_id"], rec["proc"])].append((i, rec))
+                if taxonomy:
+                    for p in _check_taxonomy(rec):
+                        bad.append((i, p))
+                if isinstance(rec.get("run_id"), str) and isinstance(
+                    rec.get("proc"), int
+                ):
+                    streams[(rec["run_id"], rec["proc"])].append((i, rec))
 
     for (run_id, proc), events in sorted(streams.items()):
         label = f"run {run_id} proc {proc}"
@@ -220,6 +234,96 @@ def check_file(
             (i, f"{label}: {msg}") for i, msg in _check_nesting(spans)
         )
     return sorted(d for d in bad if d[0] >= start_line)
+
+
+class StreamChecker:
+    """Incremental ledger lint over a live line stream — the core of
+    ``heat3d obs check --follow``. Same per-event and per-stream rules as
+    :func:`check_file`, fed one line at a time (e.g. from
+    :class:`heat3d_tpu.obs.tailer.LedgerTailer.poll_lines`); :meth:`feed`
+    returns only the defects NEW since the previous call, so a watch loop
+    prints each at most once. Line numbers count fed lines (the virtual
+    concatenation across rotated segments).
+
+    One live-mode divergence: a stream whose first event is not
+    ``ledger_open`` is flagged immediately (a live writer always opens
+    first), where the post-hoc lint waits for end-of-file to distinguish
+    "no open" from "open arrived late"."""
+
+    def __init__(self, taxonomy: bool = False):
+        self._taxonomy = taxonomy
+        self._line = 0
+        self._streams: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._reported: set = set()
+
+    @property
+    def lines_seen(self) -> int:
+        return self._line
+
+    def feed(self, raw_line: str) -> List[Defect]:
+        self._line += 1
+        i = self._line
+        bad: List[Defect] = []
+        line = raw_line.strip()
+        if not line:
+            return bad
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return [(i, "unparseable JSON")]
+        if not isinstance(rec, dict):
+            return [(i, "event is not a JSON object")]
+        bad.extend((i, p) for p in _check_event(rec))
+        if self._taxonomy:
+            bad.extend((i, p) for p in _check_taxonomy(rec))
+        if not (
+            isinstance(rec.get("run_id"), str)
+            and isinstance(rec.get("proc"), int)
+        ):
+            return bad
+        key = (rec["run_id"], rec["proc"])
+        label = f"run {key[0]} proc {key[1]}"
+        st = self._streams.get(key)
+        if st is None:
+            st = self._streams[key] = {
+                "opens": 0, "prev_seq": None, "prev_line": None, "spans": []
+            }
+            if rec.get("event") != "ledger_open":
+                bad.append(
+                    (i, f"{label}: stream did not begin with ledger_open")
+                )
+        if rec.get("event") == "ledger_open":
+            st["opens"] += 1
+            if st["opens"] > 1:
+                bad.append(
+                    (i, f"{label}: duplicate ledger_open at line {i}")
+                )
+        seq = rec.get("seq")
+        if isinstance(seq, int):
+            if st["prev_seq"] is not None and seq <= st["prev_seq"]:
+                bad.append(
+                    (
+                        i,
+                        f"{label}: seq {seq} not above seq {st['prev_seq']} "
+                        f"at line {st['prev_line']} (stream reordered or "
+                        "truncated mid-write)",
+                    )
+                )
+            st["prev_seq"], st["prev_line"] = seq, i
+        if (
+            rec.get("kind") == "span"
+            and isinstance(rec.get("t0"), (int, float))
+            and isinstance(rec.get("t1"), (int, float))
+        ):
+            st["spans"].append((i, float(rec["t0"]), float(rec["t1"])))
+            # nesting is a whole-family property: rescan this stream's
+            # accumulated spans and surface only not-yet-reported overlaps
+            for ln, msg in _check_nesting(st["spans"]):
+                d = (ln, f"{label}: {msg}")
+                if d not in self._reported:
+                    self._reported.add(d)
+                    bad.append(d)
+        return bad
 
 
 def check_file_findings(

@@ -245,6 +245,31 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
                              "(admitted + shed == submitted), order, "
                              "post-warmup compile stalls, sustained "
                              "member-Gcell/s, degraded seconds"},
+    # request tracing + live monitoring (obs/burn.py, serve/loadgen.py)
+    "serve_span": {"kind": "point", "module": "serve/queue.py",
+                   "desc": "one phase of a request's trace (trace_id, "
+                           "span queue|pack|compute|deliver|requeue_gap "
+                           "under parent 'request'), written at delivery "
+                           "with explicit t0_wall/t1_wall — a POINT "
+                           "event, not a ledger span: per-request "
+                           "windows from worker threads interleave and "
+                           "would break laminar nesting"},
+    "monitor_start": {"kind": "point", "module": "serve/loadgen.py",
+                      "desc": "live SLO monitor attached to the soak "
+                              "(fast/slow window seconds, burn "
+                              "threshold, tick interval, abort flag, "
+                              "objective names)"},
+    "slo_burn_alert": {"kind": "point", "module": "serve/loadgen.py",
+                       "desc": "an objective entered alerting: burn >= "
+                               "threshold on BOTH sliding windows "
+                               "(rising edge only — one event per "
+                               "excursion, not per tick)"},
+    "monitor_summary": {"kind": "point", "module": "serve/loadgen.py",
+                        "desc": "monitor final state at soak end: alert "
+                                "count, aborted flag, final verdict "
+                                "from the shared SLO core (test-pinned "
+                                "equal to post-hoc obs slo on the same "
+                                "ledger)"},
 }
 
 # Wrapper functions whose first argument is an event name (the taxonomy
@@ -396,6 +421,23 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
     "HEAT3D_SLO_WARN_RATIO": {"module": "obs/perf/slo.py",
                               "desc": "warn at this fraction of an SLO "
                                       "ceiling (default 0.9)"},
+    "HEAT3D_LEDGER_MAX_MB": {"module": "obs/ledger.py",
+                             "desc": "size-capped ledger rollover: the "
+                                     "live file rotates to "
+                                     "<stem>.0.jsonl, .1, ... past this "
+                                     "many MB (unset/0 = never; "
+                                     "fail-soft — a failed rotation "
+                                     "disables rotation, not the "
+                                     "ledger)"},
+    "HEAT3D_BURN_FAST_S": {"module": "obs/burn.py",
+                           "desc": "burn-rate fast window seconds "
+                                   "(default 60)"},
+    "HEAT3D_BURN_SLOW_S": {"module": "obs/burn.py",
+                           "desc": "burn-rate slow window seconds "
+                                   "(default 300; clamped >= fast)"},
+    "HEAT3D_BURN_THRESHOLD": {"module": "obs/burn.py",
+                              "desc": "burn multiple both windows must "
+                                      "reach to alert (default 1.0)"},
 }
 
 

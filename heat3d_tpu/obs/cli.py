@@ -66,6 +66,11 @@ NOTABLE = (
     "serve_metrics_summary",
     "obs_anomaly",
     "slo_verdict",
+    # live-monitor milestones (serve_span is deliberately absent: five
+    # trace phases per delivered request would drown the timeline)
+    "monitor_start",
+    "slo_burn_alert",
+    "monitor_summary",
     "timeline_export",
     "run_end",
     "ledger_close",
@@ -76,18 +81,31 @@ STEP_SPANS = ("steps", "chunk", "run_loop")
 
 
 def read_ledger(path: str) -> List[Dict[str, Any]]:
+    """All parseable events at ``path`` — including the rolled segments a
+    HEAT3D_LEDGER_MAX_MB rotation left beside it (oldest first, so the
+    concatenation is the writer's original append order). The base path
+    must exist; a rolled sibling that races away mid-read is skipped."""
+    from heat3d_tpu.obs.ledger import ledger_segments
+
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # the lint flags these; summary stays best-effort
-            if isinstance(rec, dict):
-                events.append(rec)
+    for seg in ledger_segments(path):
+        try:
+            f = open(seg)
+        except OSError:
+            if seg == path:
+                raise
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # the lint flags these; summary stays best-effort
+                if isinstance(rec, dict):
+                    events.append(rec)
     return events
 
 
@@ -462,27 +480,62 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def _tail_line(r: Dict[str, Any]) -> str:
+    base = (
+        f"{_fmt_ts(r.get('ts'))} [{str(r.get('run_id'))[:8]}/"
+        f"{r.get('proc', '?')}] {r.get('event', '?')}"
+    )
+    rest = {
+        k: v
+        for k, v in r.items()
+        if k
+        not in ("ts", "run_id", "proc", "seq", "event", "kind", "t0", "t1")
+    }
+    if r.get("kind") == "span":
+        base += f" [{_fmt_s(rest.pop('dur_s', None))}]"
+        rest.pop("depth", None)
+    return f"{base} {json.dumps(rest, default=repr)}"
+
+
 def cmd_tail(args) -> int:
+    if getattr(args, "follow", False):
+        return _tail_follow(args)
     events = read_ledger(args.ledger)
     for r in events[-args.n:]:
-        base = (
-            f"{_fmt_ts(r.get('ts'))} [{str(r.get('run_id'))[:8]}/"
-            f"{r.get('proc', '?')}] {r.get('event', '?')}"
-        )
-        rest = {
-            k: v
-            for k, v in r.items()
-            if k
-            not in ("ts", "run_id", "proc", "seq", "event", "kind", "t0", "t1")
-        }
-        if r.get("kind") == "span":
-            base += f" [{_fmt_s(rest.pop('dur_s', None))}]"
-            rest.pop("depth", None)
-        print(f"{base} {json.dumps(rest, default=repr)}")
+        print(_tail_line(r))
     return 0
 
 
+def _tail_follow(args) -> int:
+    """``tail --follow``: print the last N events, then poll the growing
+    ledger (rotation-aware via LedgerTailer) until --duration elapses
+    (0 = until interrupted)."""
+    from heat3d_tpu.obs.tailer import LedgerTailer
+
+    tailer = LedgerTailer(args.ledger)
+    deadline = (
+        time.monotonic() + args.duration if args.duration > 0 else None
+    )
+    first = True
+    try:
+        while True:
+            batch = tailer.poll()
+            if first:
+                batch = batch[-args.n:]
+                first = False
+            for r in batch:
+                print(_tail_line(r))
+            sys.stdout.flush()
+            if deadline is not None and time.monotonic() >= deadline:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_check(args) -> int:
+    if getattr(args, "follow", False):
+        return _check_follow(args)
     from heat3d_tpu.obs.check import main as check_main
 
     flags = []
@@ -491,6 +544,218 @@ def cmd_check(args) -> int:
     if args.start_line != 1:
         flags.extend(["--start-line", str(args.start_line)])
     return check_main(flags + args.ledgers)
+
+
+def _check_follow(args) -> int:
+    """``check --follow``: incremental live lint — tail each growing
+    ledger and feed new lines through the same rules as the post-hoc
+    check, reporting each defect once as it appears. rc 1 if any defect
+    surfaced by the time --duration elapses (0 = until interrupted)."""
+    from heat3d_tpu.analysis.ledgerlint import StreamChecker
+    from heat3d_tpu.obs.tailer import LedgerTailer
+
+    pairs = [
+        (path, LedgerTailer(path), StreamChecker(taxonomy=args.taxonomy))
+        for path in args.ledgers
+    ]
+    deadline = (
+        time.monotonic() + args.duration if args.duration > 0 else None
+    )
+    defects = 0
+    try:
+        while True:
+            for path, tailer, checker in pairs:
+                for raw in tailer.poll_lines():
+                    for line_no, desc in checker.feed(raw):
+                        defects += 1
+                        print(f"{path}:{line_no}: {desc}")
+            sys.stdout.flush()
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    total = sum(c.lines_seen for _, _, c in pairs)
+    print(
+        f"check --follow: {total} line(s) across {len(pairs)} ledger(s), "
+        f"{defects} defect(s)"
+    )
+    return 1 if defects else 0
+
+
+def cmd_trace(args) -> int:
+    """``obs trace LEDGER REQUEST``: one request's end-to-end
+    decomposition — queue / pack / compute / deliver shares plus requeue
+    gaps — reconstructed from its ``serve_span`` events (rotation-aware).
+    ``REQUEST`` is the integer request id or the 12-hex trace id. rc 1
+    when the request has no trace in the ledger, rc 2 unreadable."""
+    try:
+        events = read_ledger(args.ledger)
+    except OSError as e:
+        print(f"trace: cannot read ledger: {e}", file=sys.stderr)
+        return 2
+    want_rid: Optional[int] = None
+    try:
+        want_rid = int(args.request)
+    except ValueError:
+        pass
+    spans = [
+        r
+        for r in events
+        if r.get("event") == "serve_span"
+        and isinstance(r.get("t0_wall"), (int, float))
+        and isinstance(r.get("t1_wall"), (int, float))
+        and (
+            r.get("request_id") == want_rid
+            if want_rid is not None
+            else r.get("trace_id") == args.request
+        )
+    ]
+    if not spans:
+        print(
+            f"trace: no serve_span events for request {args.request!r} "
+            f"in {args.ledger}",
+            file=sys.stderr,
+        )
+        return 1
+    root = next((r for r in spans if r.get("span") == "request"), spans[0])
+    t0 = min(float(r["t0_wall"]) for r in spans)
+    total = max(float(root["t1_wall"]) - float(root["t0_wall"]), 1e-12)
+    rid = root.get("request_id")
+    # shed events cannot carry a request id (a shed request never got
+    # one); requeues do — annotate from the serve_requeue events too
+    requeues = [
+        r
+        for r in events
+        if r.get("event") == "serve_requeue"
+        and isinstance(r.get("request_ids"), list)
+        and rid in r["request_ids"]
+    ]
+    phases = []
+    for r in spans:
+        w0, w1 = float(r["t0_wall"]), float(r["t1_wall"])
+        rec = {
+            "span": r.get("span"),
+            "start_s": round(w0 - t0, 6),
+            "dur_s": round(w1 - w0, 6),
+            "share": round((w1 - w0) / total, 4),
+        }
+        for k in ("attempt", "backoff_s"):
+            if r.get(k) is not None:
+                rec[k] = r[k]
+        phases.append(rec)
+    phases.sort(key=lambda p: (p["start_s"], -p["dur_s"]))
+    out = {
+        "request_id": rid,
+        "trace_id": root.get("trace_id"),
+        "bucket": root.get("bucket"),
+        "stream": root.get("stream"),
+        "attempts": root.get("attempts"),
+        "total_s": round(total, 6),
+        "phases": phases,
+        "requeues": len(requeues),
+    }
+    if args.as_json:
+        print(json.dumps(out))
+        return 0
+    head = f"request {rid} trace {out['trace_id']}"
+    if out.get("bucket"):
+        head += f" bucket {out['bucket']}"
+    if out.get("stream"):
+        head += f" stream {out['stream']}"
+    print(f"{head}: total {_fmt_s(total)} ({out['attempts']} attempt(s))")
+    for p in phases:
+        extra = ""
+        if p["span"] == "requeue_gap":
+            extra = (
+                f"  (attempt {p.get('attempt')}, "
+                f"backoff {_fmt_s(p.get('backoff_s'))})"
+            )
+        print(
+            f"  {p['span']:<12} +{p['start_s']:.3f}s  "
+            f"{_fmt_s(p['dur_s']):>10}  {p['share']:>7.1%}{extra}"
+        )
+    if requeues:
+        print(f"  ({len(requeues)} serve_requeue event(s) touched this request)")
+    return 0
+
+
+def _watch_block(status: Dict[str, Any]) -> List[str]:
+    lines = [
+        f"-- watch @ {_fmt_ts(status.get('t_end'))} "
+        f"({status['events_seen']} events, window {status['window_s']:g}s)",
+        f"   arrivals {status['arrival_hz']}/s  "
+        f"deliveries {status['delivery_hz']}/s  "
+        f"queue depth {status.get('queue_depth')}",
+    ]
+    for bucket, st in sorted((status.get("buckets") or {}).items()):
+        lines.append(
+            f"   {bucket}: n={st.get('count')} "
+            f"p50 {_fmt_s(st.get('p50_s'))} p95 {_fmt_s(st.get('p95_s'))} "
+            f"p99 {_fmt_s(st.get('p99_s'))}"
+        )
+    if status.get("degraded") or status.get("degraded_s"):
+        lines.append(
+            f"   degraded: {bool(status.get('degraded'))} "
+            f"(cumulative {_fmt_s(status.get('degraded_s'))})"
+        )
+    burn = status.get("burn") or {}
+    for o in burn.get("objectives", []):
+        fast, slow = o["fast"], o["slow"]
+        mark = " ALERT" if o.get("alerting") else ""
+        f_burn = "-" if fast["burn"] is None else f"{fast['burn']:.2f}"
+        s_burn = "-" if slow["burn"] is None else f"{slow['burn']:.2f}"
+        lines.append(
+            f"   burn {o['name']}: fast({fast['window_s']:g}s) {f_burn}  "
+            f"slow({slow['window_s']:g}s) {s_burn}{mark}"
+        )
+    if status.get("flags"):
+        lines.append(
+            "   flags: "
+            + "  ".join(f"{k}={v}" for k, v in sorted(status["flags"].items()))
+        )
+    return lines
+
+
+def cmd_watch(args) -> int:
+    """``obs watch LEDGER``: the live terminal view — tail the growing
+    ledger through the streaming burn-rate evaluator and print a status
+    block per tick. ``--once`` does a single pass (post-hoc replay of
+    whatever the ledger holds now) and exits; rc is 1 when any objective
+    is alerting at the end, 0 otherwise, 2 on an unreadable spec."""
+    from heat3d_tpu.obs.burn import BurnEvaluator
+    from heat3d_tpu.obs.perf.slo import load_spec
+    from heat3d_tpu.obs.tailer import LedgerTailer
+
+    try:
+        spec = load_spec(args.spec)
+    except (OSError, ValueError) as e:
+        print(f"watch: {e}", file=sys.stderr)
+        return 2
+    be = BurnEvaluator(spec)
+    tailer = LedgerTailer(args.ledger)
+    deadline = (
+        time.monotonic() + args.duration if args.duration > 0 else None
+    )
+    status: Dict[str, Any] = {}
+    try:
+        while True:
+            be.consume(tailer.poll())
+            status = be.status()
+            if args.as_json:
+                print(json.dumps(status))
+            else:
+                for line in _watch_block(status):
+                    print(line)
+            sys.stdout.flush()
+            if args.once or (
+                deadline is not None and time.monotonic() >= deadline
+            ):
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 1 if (status.get("burn") or {}).get("alerting") else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -523,6 +788,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     t = sub.add_parser("tail", help="last N events, one per line")
     t.add_argument("ledger")
     t.add_argument("-n", type=int, default=20)
+    t.add_argument(
+        "--follow", action="store_true",
+        help="keep polling the growing ledger (rotation-aware)",
+    )
+    t.add_argument("--interval", type=float, default=0.5)
+    t.add_argument(
+        "--duration", type=float, default=0.0,
+        help="stop following after this many seconds (0 = until ^C)",
+    )
     t.set_defaults(fn=cmd_tail)
 
     c = sub.add_parser("check", help="schema lint (same as scripts/check_ledger.py)")
@@ -537,7 +811,51 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="report only defects at/after this line (append-mode "
         "session scoping)",
     )
+    c.add_argument(
+        "--follow", action="store_true",
+        help="live lint: tail the growing ledger(s) and report each "
+        "defect once as it appears",
+    )
+    c.add_argument("--interval", type=float, default=0.5)
+    c.add_argument(
+        "--duration", type=float, default=0.0,
+        help="stop following after this many seconds (0 = until ^C)",
+    )
     c.set_defaults(fn=cmd_check)
+
+    tr = sub.add_parser(
+        "trace",
+        help="one request's queue/pack/compute/deliver decomposition "
+        "from its serve_span events",
+    )
+    tr.add_argument("ledger")
+    tr.add_argument(
+        "request", help="request id (integer) or 12-hex trace id"
+    )
+    tr.add_argument("--json", action="store_true", dest="as_json")
+    tr.set_defaults(fn=cmd_trace)
+
+    w = sub.add_parser(
+        "watch",
+        help="live serve-tier view: rates, queue depth, windowed bucket "
+        "percentiles, SLO burn rate per objective, anomaly flags",
+    )
+    w.add_argument("ledger")
+    w.add_argument(
+        "--spec", default=None,
+        help="SLO spec JSON (default: $HEAT3D_SLO_SPEC or built-in)",
+    )
+    w.add_argument("--interval", type=float, default=2.0)
+    w.add_argument(
+        "--duration", type=float, default=0.0,
+        help="stop watching after this many seconds (0 = until ^C)",
+    )
+    w.add_argument(
+        "--once", action="store_true",
+        help="one evaluation pass over the current ledger, then exit",
+    )
+    w.add_argument("--json", action="store_true", dest="as_json")
+    w.set_defaults(fn=cmd_watch)
 
     # listed for --help discoverability; dispatched above before parsing
     sub.add_parser(

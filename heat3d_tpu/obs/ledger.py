@@ -43,6 +43,7 @@ import uuid
 from typing import Any, Dict, Optional
 
 ENV_LEDGER = "HEAT3D_LEDGER"
+ENV_LEDGER_MAX_MB = "HEAT3D_LEDGER_MAX_MB"
 SCHEMA_VERSION = 1
 
 # Fields every event must carry (the contract scripts/check_ledger.py
@@ -71,6 +72,43 @@ def _process_index() -> int:
 
 def _new_run_id() -> str:
     return uuid.uuid4().hex[:12]
+
+
+def _segment_path(path: str, idx: int) -> str:
+    """Rolled-segment naming: ``ledger.jsonl`` rolls to ``ledger.0.jsonl``,
+    ``ledger.1.jsonl``, ... (oldest first); the base path is always the
+    active file."""
+    stem, ext = os.path.splitext(path)
+    return f"{stem}.{idx}{ext}" if ext else f"{path}.{idx}"
+
+
+def _env_max_bytes() -> int:
+    """Rotation cap from ``HEAT3D_LEDGER_MAX_MB`` (float MB; unset,
+    unparseable, or <= 0 disables rotation)."""
+    raw = os.environ.get(ENV_LEDGER_MAX_MB, "")
+    if not raw:
+        return 0
+    try:
+        mb = float(raw)
+    except ValueError:
+        return 0
+    return int(mb * 1e6) if mb > 0 else 0
+
+
+def ledger_segments(path: str) -> "list[str]":
+    """All on-disk segments of a (possibly rotated) ledger, oldest first,
+    the active base path last. With no rolled siblings this is just
+    ``[path]`` — readers can call it unconditionally."""
+    out = []
+    i = 0
+    while True:
+        seg = _segment_path(path, i)
+        if not os.path.exists(seg):
+            break
+        out.append(seg)
+        i += 1
+    out.append(path)
+    return out
 
 
 class SpanHandle:
@@ -153,6 +191,17 @@ class Ledger:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a")
+        # size-capped rotation (HEAT3D_LEDGER_MAX_MB): one continuous
+        # (run_id, proc, seq) stream spans the rolled segments, so the
+        # lint's per-stream checks hold on the concatenation
+        self._max_bytes = _env_max_bytes()
+        self._rolled = 0
+        while os.path.exists(_segment_path(path, self._rolled)):
+            self._rolled += 1
+        try:
+            self._bytes = self._f.tell()
+        except OSError:
+            self._bytes = 0
         open_fields = {
             "schema": SCHEMA_VERSION,
             "pid": os.getpid(),
@@ -230,6 +279,9 @@ class Ledger:
             try:
                 self._f.write(line + "\n")
                 self._f.flush()
+                self._bytes += len(line) + 1
+                if self._max_bytes and self._bytes >= self._max_bytes:
+                    self._rotate_locked()
             except (OSError, ValueError) as e:
                 # telemetry must never kill the run it observes: a failed
                 # write (disk full, path gone read-only mid-run) disables
@@ -243,6 +295,32 @@ class Ledger:
                     f"({type(e).__name__}: {e}); further events dropped",
                     file=sys.stderr,
                 )
+
+    def _rotate_locked(self) -> None:
+        """Roll the active file aside (``ledger.N.jsonl``, oldest ``.0``)
+        and reopen the base path fresh; called under ``self._lock`` after a
+        successful write. The rename preserves byte content, so a tailer's
+        saved offset into the old base carries into the rolled segment.
+        Fail-soft: any OSError disables rotation (one stderr note) and the
+        ledger keeps appending to whatever file is open."""
+        try:
+            self._f.close()
+            os.replace(self.path, _segment_path(self.path, self._rolled))
+            self._rolled += 1
+            self._f = open(self.path, "a")
+            self._bytes = 0
+        except OSError as e:
+            self._max_bytes = 0
+            print(
+                f"heat3d: ledger rotation for {self.path} disabled "
+                f"({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
+            try:
+                if self._f.closed:
+                    self._f = open(self.path, "a")
+            except OSError:
+                pass  # next _write sees a closed file and drops, per fail-soft
 
     # ---- public API ------------------------------------------------------
 

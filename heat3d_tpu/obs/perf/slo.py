@@ -217,87 +217,109 @@ def _status(burn: Optional[float], warn_ratio: float) -> str:
     return "ok"
 
 
+def evaluate_objective(
+    o: Dict[str, Any],
+    serve_summary: Optional[Dict[str, Any]],
+    step_samples: List[float],
+    phase_us: Optional[Dict[str, float]],
+    warn_ratio: float,
+) -> Dict[str, Any]:
+    """ONE objective judged against prepared inputs — the shared core
+    behind both the post-hoc gate (:func:`evaluate`) and the streaming
+    burn-rate monitor (``obs.burn.BurnEvaluator``), so the two paths
+    cannot drift: same worst-bucket rule, same rounding, same status
+    bands. Returns the per-objective record (value / target / burn_rate /
+    status)."""
+    from heat3d_tpu.obs.metrics import percentile
+
+    kind = o["kind"]
+    rec: Dict[str, Any] = {
+        "name": o.get("name", kind),
+        "kind": kind,
+    }
+    value = None
+    if kind == "serve_latency":
+        rec["target_s"] = float(o["max_s"])
+        field = f"p{o['percentile']}_s"
+        want = o.get("bucket")
+        per_bucket = {}
+        for bucket, st in ((serve_summary or {}).get("buckets") or {}).items():
+            if want and want not in str(bucket):
+                continue
+            v = st.get(field) if isinstance(st, dict) else None
+            if isinstance(v, (int, float)):
+                per_bucket[str(bucket)] = round(float(v), 6)
+        if per_bucket:
+            # the WORST matching bucket governs: an SLO met on average
+            # but breached on one bucket is breached
+            worst = max(per_bucket, key=per_bucket.get)
+            value = per_bucket[worst]
+            rec["bucket"] = worst
+            rec["buckets"] = per_bucket
+        burn = None if value is None else value / rec["target_s"]
+    elif kind == "step_time":
+        rec["target_s"] = float(o["max_s"])
+        if step_samples:
+            value = float(percentile(step_samples, o["percentile"]))
+            rec["samples"] = len(step_samples)
+        burn = None if value is None else value / rec["target_s"]
+    elif kind == "serve_degraded":
+        rec["target_s"] = float(o["max_s"])
+        ds = (serve_summary or {}).get("degraded_s")
+        if isinstance(ds, (int, float)):
+            value = float(ds)
+            if (serve_summary or {}).get("degraded"):
+                rec["still_degraded"] = True
+            rq = (serve_summary or {}).get("requeues")
+            if isinstance(rq, int):
+                rec["requeues"] = rq
+        burn = None if value is None else value / rec["target_s"]
+    else:  # halo_share
+        rec["target_frac"] = float(o["max_frac"])
+        if phase_us:
+            known = {
+                ph: us
+                for ph, us in phase_us.items()
+                if ph != "(unattributed)"
+            }
+            total = sum(known.values())
+            if total > 0:
+                value = known.get("halo_exchange", 0.0) / total
+        burn = None if value is None else value / rec["target_frac"]
+    rec["value"] = None if value is None else round(float(value), 6)
+    rec["burn_rate"] = None if burn is None else round(burn, 4)
+    rec["status"] = _status(burn, warn_ratio)
+    return rec
+
+
 def evaluate(
     events: List[Dict[str, Any]],
     spec: Dict[str, Any],
     serve_summary: Optional[Dict[str, Any]] = None,
     phase_us: Optional[Dict[str, float]] = None,
     warn_ratio: Optional[float] = None,
+    step_samples: Optional[List[float]] = None,
 ) -> Dict[str, Any]:
     """Evaluate every objective in ``spec`` against the ledger ``events``
     (plus an optional live ``serve_summary`` — the serve CLI's drain
     wiring passes the queue's own summary so the verdict never waits on a
     ledger re-read — and a profile's ``phase_us`` for halo_share).
-    Returns the machine report: per-objective value/target/burn-rate/
-    status and the overall verdict (``breach`` > ``warn`` > ``pass``)."""
+    ``step_samples`` overrides the ledger reconstruction (the streaming
+    monitor passes its own accumulated samples). Returns the machine
+    report: per-objective value/target/burn-rate/status and the overall
+    verdict (``breach`` > ``warn`` > ``pass``)."""
     from heat3d_tpu.obs.cli import step_latencies
-    from heat3d_tpu.obs.metrics import percentile
 
     wr = _warn_ratio(spec, warn_ratio)
     if serve_summary is None:
         serve_summary = serve_summary_from_events(events)
-    step_samples = step_latencies(events)
+    if step_samples is None:
+        step_samples = step_latencies(events)
 
-    results: List[Dict[str, Any]] = []
-    for o in spec.get("objectives", []):
-        kind = o["kind"]
-        rec: Dict[str, Any] = {
-            "name": o.get("name", kind),
-            "kind": kind,
-        }
-        value = None
-        if kind == "serve_latency":
-            rec["target_s"] = float(o["max_s"])
-            field = f"p{o['percentile']}_s"
-            want = o.get("bucket")
-            per_bucket = {}
-            for bucket, st in ((serve_summary or {}).get("buckets") or {}).items():
-                if want and want not in str(bucket):
-                    continue
-                v = st.get(field) if isinstance(st, dict) else None
-                if isinstance(v, (int, float)):
-                    per_bucket[str(bucket)] = round(float(v), 6)
-            if per_bucket:
-                # the WORST matching bucket governs: an SLO met on average
-                # but breached on one bucket is breached
-                worst = max(per_bucket, key=per_bucket.get)
-                value = per_bucket[worst]
-                rec["bucket"] = worst
-                rec["buckets"] = per_bucket
-            burn = None if value is None else value / rec["target_s"]
-        elif kind == "step_time":
-            rec["target_s"] = float(o["max_s"])
-            if step_samples:
-                value = float(percentile(step_samples, o["percentile"]))
-                rec["samples"] = len(step_samples)
-            burn = None if value is None else value / rec["target_s"]
-        elif kind == "serve_degraded":
-            rec["target_s"] = float(o["max_s"])
-            ds = (serve_summary or {}).get("degraded_s")
-            if isinstance(ds, (int, float)):
-                value = float(ds)
-                if (serve_summary or {}).get("degraded"):
-                    rec["still_degraded"] = True
-                rq = (serve_summary or {}).get("requeues")
-                if isinstance(rq, int):
-                    rec["requeues"] = rq
-            burn = None if value is None else value / rec["target_s"]
-        else:  # halo_share
-            rec["target_frac"] = float(o["max_frac"])
-            if phase_us:
-                known = {
-                    ph: us
-                    for ph, us in phase_us.items()
-                    if ph != "(unattributed)"
-                }
-                total = sum(known.values())
-                if total > 0:
-                    value = known.get("halo_exchange", 0.0) / total
-            burn = None if value is None else value / rec["target_frac"]
-        rec["value"] = None if value is None else round(float(value), 6)
-        rec["burn_rate"] = None if burn is None else round(burn, 4)
-        rec["status"] = _status(burn, wr)
-        results.append(rec)
+    results = [
+        evaluate_objective(o, serve_summary, step_samples, phase_us, wr)
+        for o in spec.get("objectives", [])
+    ]
 
     statuses = [r["status"] for r in results]
     verdict = (

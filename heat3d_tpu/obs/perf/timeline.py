@@ -332,14 +332,35 @@ def to_chrome_trace(
     per-phase totals export as ONE aggregate track: each phase is a slice
     whose duration is its total device time — honest about being an
     aggregate, not a placement (per-op placement lives in the xplane
-    itself, which Perfetto opens natively)."""
+    itself, which Perfetto opens natively). ``serve_span`` request-trace
+    events (points carrying wall-clock ``t0_wall``/``t1_wall`` — see
+    serve/queue.new_trace) additionally render as a per-request
+    waterfall: one dedicated pid, one tid per request, the queue / pack /
+    compute / deliver phases (and requeue gaps) as slices under the
+    request's root span."""
     trace: List[Dict[str, Any]] = []
+    req_spans = [
+        e
+        for e in tl_events
+        if e["name"] == "serve_span"
+        and isinstance(e["args"].get("t0_wall"), (int, float))
+        and isinstance(e["args"].get("t1_wall"), (int, float))
+    ]
     if tl_events:
         base = min(e["t_wall"] for e in tl_events)
+        if req_spans:
+            # a request's queue phase starts at submit — earlier than any
+            # serve_span EMISSION ts; the origin must cover it
+            base = min(
+                base, min(e["args"]["t0_wall"] for e in req_spans)
+            )
     else:
         base = 0.0
     pids: Dict[Tuple[str, Any], int] = {}
+    req_ids = {id(e) for e in req_spans}
     for e in tl_events:
+        if id(e) in req_ids:
+            continue  # rendered on the waterfall track below, not as instants
         stream = (e["src"], e["proc"])
         if stream not in pids:
             pid = len(pids) + 1
@@ -368,8 +389,48 @@ def to_chrome_trace(
                     "tid": 0, "ts": ts_us, "args": e["args"],
                 }
             )
-    if profile_totals:
+    if req_spans:
+        # the per-request waterfall: one tid per request, phases as X
+        # slices at their wall-clock bounds (the root "request" span
+        # contains its phases by time, so Perfetto nests them)
         pid = len(pids) + 1
+        trace.append(
+            {
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": "requests (serve traces)"},
+            }
+        )
+        tids: Dict[Any, int] = {}
+        for e in req_spans:
+            a = e["args"]
+            rid = a.get("request_id")
+            if rid not in tids:
+                tids[rid] = len(tids)
+                trace.append(
+                    {
+                        "name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tids[rid],
+                        "args": {
+                            "name": f"req {rid} [{a.get('trace_id')}]"
+                        },
+                    }
+                )
+            t0w, t1w = float(a["t0_wall"]), float(a["t1_wall"])
+            trace.append(
+                {
+                    "name": str(a.get("span", "?")), "ph": "X",
+                    "pid": pid, "tid": tids[rid],
+                    "ts": round((t0w - base) * 1e6, 3),
+                    "dur": round(max(t1w - t0w, 0.0) * 1e6, 3),
+                    "args": {
+                        k: v
+                        for k, v in a.items()
+                        if k not in ("t0_wall", "t1_wall") and v is not None
+                    },
+                }
+            )
+    if profile_totals:
+        pid = len(pids) + 2 if req_spans else len(pids) + 1
         trace.append(
             {
                 "name": "process_name", "ph": "M", "pid": pid,

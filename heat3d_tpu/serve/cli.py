@@ -159,6 +159,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "to stdout, exit 0 only when it passes")
     p.add_argument("--duration", type=float, default=None,
                    help="(--loadgen) override the spec's duration_s")
+    p.add_argument("--monitor", action="store_true",
+                   help="(--loadgen) live SLO burn-rate monitoring: a "
+                   "thread tails the run's own ledger during the soak, "
+                   "re-judging the SLO spec over sliding fast/slow "
+                   "windows (obs/burn.py) and landing slo_burn_alert "
+                   "events on each objective's rising edge; needs "
+                   "--ledger/$HEAT3D_LEDGER; watch live with "
+                   "`heat3d obs watch LEDGER`")
+    p.add_argument("--abort-on-burn", action="store_true",
+                   help="(--loadgen, implies --monitor) terminate the "
+                   "replay early when any objective alerts on both "
+                   "windows — the soak exits 1 with a machine-readable "
+                   "partial verdict instead of burning its full "
+                   "duration")
     p.add_argument("--row", default=None, metavar="FILE.jsonl",
                    help="(--loadgen) append the soak's provenance row "
                    "(bench=soak; check_provenance.py-checked) to this "
@@ -407,8 +421,33 @@ def _serve_loadgen(args) -> int:
     else:
         slo_spec = dict(loadgen.DEFAULT_SOAK_SLO)
 
+    # live-monitor resolution: the mix's "monitor" block tunes windows /
+    # threshold / cadence; the FLAGS enable it (a committed spec should
+    # not silently grow a monitoring thread). --abort-on-burn implies
+    # --monitor. Monitoring without a ledger is a config error (rc 2,
+    # validated before the soak burns its duration).
+    monitor_cfg = None
+    if args.monitor or args.abort_on_burn:
+        if not obs.get().active:
+            raise ValueError(
+                "--monitor needs a run ledger (--ledger or "
+                "$HEAT3D_LEDGER) — the live evaluator tails the run's "
+                "own event stream"
+            )
+        mblock = mix.get("monitor")
+        mblock = mblock if isinstance(mblock, dict) else {}
+        monitor_cfg = {
+            "spec": slo_spec,
+            "abort_on_burn": bool(args.abort_on_burn),
+            "interval_s": mblock.get("interval_s"),
+            "fast_window_s": mblock.get("fast_window_s"),
+            "slow_window_s": mblock.get("slow_window_s"),
+            "threshold": mblock.get("threshold"),
+        }
+
     verdict = loadgen.run_soak(
-        mix, _base_from_record, _scenario_from_record
+        mix, _base_from_record, _scenario_from_record,
+        monitor=monitor_cfg,
     )
     report = slo_mod.evaluate(
         [], slo_spec,
@@ -427,7 +466,16 @@ def _serve_loadgen(args) -> int:
         out["slo"] = report["verdict"]
         out["ok"] = ok
         print(json.dumps({"soak_verdict": out}), flush=True)
-    if not verdict["ok"]:
+    if verdict.get("aborted"):
+        mon_info = verdict.get("monitor") or {}
+        print(
+            "heat3d serve: soak ABORTED early on SLO burn "
+            f"(alerted: {', '.join(mon_info.get('alerted', [])) or '?'}; "
+            f"replayed {verdict['submitted']} of {verdict['arrivals']} "
+            "arrivals) — partial verdict above",
+            file=sys.stderr,
+        )
+    elif not verdict["ok"]:
         print(
             "heat3d serve: soak failed its own checks "
             f"(accounting_ok={verdict['accounting_ok']}, "

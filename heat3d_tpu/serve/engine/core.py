@@ -99,6 +99,7 @@ from heat3d_tpu.serve.queue import (
     _env_int,
     _padded_size,
     build_chunk_results,
+    new_trace,
     pad_batch,
     run_packed_batch,
 )
@@ -163,6 +164,9 @@ class _Tracked:
     # backend-loss requeue count: the chunk fails for real once the
     # shared RetryPolicy's attempt cap is reached
     attempts: int = 0
+    # per-request trace context (serve/queue.new_trace): the trace_id
+    # survives requeues because the _Tracked object does
+    trace: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -472,12 +476,15 @@ class AsyncServeEngine:
                 self._stream_open[stream] = s_open + 1
                 first_on_stream = stream not in self._admission_noted
                 self._admission_noted.add(stream)
+                trace = new_trace()
+                trace["stream"] = stream or None
                 self._req[rid] = _Tracked(
                     request_id=rid,
                     base=base,
                     scenario=scenario,
                     stream=stream,
-                    submitted_at=time.monotonic(),
+                    submitted_at=trace["t_submit"],
+                    trace=trace,
                 )
                 self._streams.setdefault(stream, []).append(rid)
                 bucket = str(solver_bucket_key(base))
@@ -517,6 +524,7 @@ class AsyncServeEngine:
         obs.get().event(
             "serve_submit",
             request_id=rid,
+            trace_id=trace["id"],
             grid=list(base.grid.shape),
             stencil=base.stencil.kind,
             steps=scenario.steps,
@@ -590,8 +598,11 @@ class AsyncServeEngine:
                         del lanes[stream]
                     if len(chunk) >= self.max_batch:
                         break
+            t_pack = time.monotonic()
             for r in chunk:
                 r.state = _DISPATCHED
+                if r.trace is not None:
+                    r.trace["packs"].append(t_pack)
             self._busy.add(bucket)
             out.append((worker, chunk))
         return out
@@ -709,6 +720,7 @@ class AsyncServeEngine:
             # except below, classifies as backend loss, and requeues —
             # exactly the path a real mid-batch device loss takes
             self._faults.on_serve_batch(batch_seq)
+            t_ex0 = time.monotonic()
             with obs.get().span(
                 "serve_batch", members=len(chunk), padded=padded
             ) as span:
@@ -742,8 +754,12 @@ class AsyncServeEngine:
         finally:
             with self._cond:
                 self._in_flight -= 1
+        t_ex1 = time.monotonic()
+        for r in chunk:
+            if r.trace is not None:
+                r.trace["exec"].append((t_ex0, t_ex1))
         results = build_chunk_results(
-            [(r.request_id, r.submitted_at) for r in chunk],
+            [(r.request_id, r.submitted_at, r.trace) for r in chunk],
             bucket_s, budgets, fields, residuals, snapshots, self._stats,
         )
         # a REQUEUED chunk finally succeeding closes the degraded window
@@ -779,12 +795,18 @@ class AsyncServeEngine:
                 "exhausted — failing the chunk", attempt,
             )
             return False
+        delay = self._retry.delay_for(attempt)
+        t_rq = time.monotonic()
         with self._cond:
             if self._stop:
                 return False
             for r in chunk:
                 r.state = _PENDING
                 r.attempts = attempt
+                if r.trace is not None:
+                    r.trace["requeues"].append(
+                        {"t": t_rq, "attempt": attempt, "backoff_s": delay}
+                    )
         # rebuild, don't reuse: the cached ensembles hold programs
         # compiled for the pre-loss device set; dropping them makes the
         # next dispatch rebuild on whatever mesh NOW exists (the AOT
@@ -795,7 +817,6 @@ class AsyncServeEngine:
         # on the degraded window (refcounted — another chunk recovering
         # must not stop the clock while this one still backs off)
         self._stats.mark_degraded(new=attempt == 1)
-        delay = self._retry.delay_for(attempt)
         obs.get().event(
             "serve_requeue",
             bucket=worker.bucket,

@@ -104,7 +104,8 @@ def validate_mix(mix: Dict[str, Any]) -> Dict[str, Any]:
     wasted hour)."""
     _require(isinstance(mix, dict), "top level must be an object")
     known = {
-        "duration_s", "seed", "rate_hz", "ramp", "engine", "streams", "slo"
+        "duration_s", "seed", "rate_hz", "ramp", "engine", "streams", "slo",
+        "monitor",
     }
     unknown = set(mix) - known
     _require(not unknown, f"unknown key(s) {sorted(unknown)}")
@@ -189,6 +190,22 @@ def validate_mix(mix: Dict[str, Any]) -> Dict[str, Any]:
             )
     engine = mix.get("engine", {})
     _require(isinstance(engine, dict), "engine must be an object")
+    monitor = mix.get("monitor")
+    if monitor is not None:
+        _require(isinstance(monitor, dict), "monitor must be an object")
+        mon_known = {
+            "interval_s", "fast_window_s", "slow_window_s", "threshold"
+        }
+        unknown_m = set(monitor) - mon_known
+        _require(
+            not unknown_m, f"monitor: unknown key(s) {sorted(unknown_m)}"
+        )
+        for k in mon_known:
+            v = monitor.get(k)
+            _require(
+                v is None or (isinstance(v, (int, float)) and v > 0),
+                f"monitor.{k} must be a positive number",
+            )
     return mix
 
 
@@ -306,11 +323,134 @@ def _percentile(sorted_vals: List[float], pct: float) -> float:
     return sorted_vals[idx]
 
 
+class _Monitor:
+    """The soak's live leg (``serve --loadgen --monitor``): a thread that
+    tails the run's OWN ledger while the replay is in flight, re-judging
+    the SLO spec as burn rate over sliding fast/slow windows
+    (:class:`heat3d_tpu.obs.burn.BurnEvaluator`), landing one
+    ``slo_burn_alert`` per objective RISING EDGE (enter-alerting, not
+    every tick), and — under ``abort_on_burn`` — tripping the abort
+    event the arrivals loop honors, so a soak that is already condemned
+    dies in minutes with a machine-readable partial verdict instead of
+    burning its full duration."""
+
+    def __init__(self, engine, cfg: Dict[str, Any], ledger_path: str):
+        from heat3d_tpu.obs.burn import BurnEvaluator
+        from heat3d_tpu.obs.tailer import LedgerTailer
+
+        self._engine = engine
+        self._spec = cfg["spec"]
+        self.abort_on_burn = bool(cfg.get("abort_on_burn"))
+        self.interval_s = float(cfg.get("interval_s") or 2.0)
+        self._be = BurnEvaluator(
+            self._spec,
+            fast_s=cfg.get("fast_window_s"),
+            slow_s=cfg.get("slow_window_s"),
+            threshold=cfg.get("threshold"),
+        )
+        self._tailer = LedgerTailer(ledger_path)
+        self.abort = threading.Event()
+        self.alerts = 0
+        self.alerted: List[str] = []
+        self._was_alerting: set = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="heat3d-soak-monitor", daemon=True
+        )
+
+    def start(self) -> None:
+        obs.get().event(
+            "monitor_start",
+            interval_s=self.interval_s,
+            fast_window_s=self._be.fast_s,
+            slow_window_s=self._be.slow_s,
+            threshold=self._be.threshold,
+            abort_on_burn=self.abort_on_burn,
+            objectives=[
+                o.get("name", o.get("kind"))
+                for o in self._spec.get("objectives", [])
+            ],
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._tick()
+
+    def _tick(self) -> None:
+        # flush the engine's summary first (dirty-gated no-op when
+        # clean) so the cumulative degraded/requeue budgets the
+        # evaluator carries stay current between deliveries
+        self._engine._emit_summary()
+        self._be.consume(self._tailer.poll())
+        rep = self._be.evaluate()
+        now_alerting = set(rep["alerting"])
+        for obj in rep["objectives"]:
+            name = obj["name"]
+            if name not in now_alerting or name in self._was_alerting:
+                continue
+            self.alerts += 1
+            self.alerted.append(name)
+            obs.get().event(
+                "slo_burn_alert",
+                objective=name,
+                kind_=obj["kind"],
+                fast_burn=obj["fast"]["burn"],
+                slow_burn=obj["slow"]["burn"],
+                fast_window_s=rep["fast_window_s"],
+                slow_window_s=rep["slow_window_s"],
+                threshold=rep["threshold"],
+                value=obj["fast"]["value"],
+                bucket=obj["fast"].get("bucket"),
+            )
+            log.warning(
+                "SLO burn alert: %s fast=%.3gx slow=%.3gx (threshold "
+                "%.3gx)",
+                name, obj["fast"]["burn"] or 0.0,
+                obj["slow"]["burn"] or 0.0, rep["threshold"],
+            )
+        self._was_alerting = now_alerting
+        if now_alerting and self.abort_on_burn:
+            self.abort.set()
+
+    def finalize(self) -> Dict[str, Any]:
+        """Stop the thread, drain the tail (the engine's final
+        ``serve_metrics_summary`` landed at shutdown), and emit
+        ``monitor_summary`` — the live evaluator's final state fed
+        through the same shared core a post-hoc ``heat3d obs slo`` on
+        this ledger uses, so the two agree by construction (the soak
+        battery pins it). Returns the verdict's ``monitor`` block."""
+        self._stop.set()
+        self._thread.join(timeout=60)
+        self._be.consume(self._tailer.poll())
+        final = self._be.final_verdict()
+        info = {
+            "alerts": self.alerts,
+            "alerted": self.alerted,
+            "aborted": self.abort.is_set(),
+            "fast_window_s": self._be.fast_s,
+            "slow_window_s": self._be.slow_s,
+            "threshold": self._be.threshold,
+            "final": final["verdict"],
+            "objectives": [
+                {
+                    "name": o["name"],
+                    "status": o["status"],
+                    "burn_rate": o["burn_rate"],
+                }
+                for o in final["objectives"]
+            ],
+        }
+        obs.get().event("monitor_summary", **info)
+        return info
+
+
 def run_soak(
     mix: Dict[str, Any],
     base_for_record,
     scenario_for_record,
     slo_spec: Optional[Dict[str, Any]] = None,
+    monitor: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Execute the soak: warmup, open-loop replay, collect, judge.
 
@@ -333,6 +473,20 @@ def run_soak(
     dur = float(mix["duration_s"])
     eng_kw = dict(mix.get("engine", {}))
     engine = AsyncServeEngine(autostart=True, **eng_kw)
+
+    # live monitoring leg: constructed BEFORE warmup so a misconfigured
+    # monitor (no ledger to tail) fails at soak start, started after —
+    # warmup emits no serve traffic worth judging
+    mon: Optional[_Monitor] = None
+    if monitor is not None:
+        ledger_path = obs.get().path
+        if not ledger_path:
+            raise ValueError(
+                "--monitor needs a run ledger (--ledger or "
+                "$HEAT3D_LEDGER) — the live evaluator tails the run's "
+                "own event stream"
+            )
+        mon = _Monitor(engine, monitor, ledger_path)
 
     # resolve every stream's records to (base, scenario) ONCE — a bad
     # record must fail at soak start, not minutes in
@@ -397,15 +551,25 @@ def run_soak(
     )
     collector.start()
 
+    if mon is not None:
+        mon.start()
+    # the abort event doubles as the arrivals-loop sleep: an alert mid
+    # inter-arrival gap wakes the loop immediately instead of after the
+    # gap (an unmonitored soak keeps a plain never-set event — one code
+    # path, zero behavior change)
+    abort_ev = mon.abort if mon is not None else threading.Event()
+
     submitted = 0
     shed = 0
     t0 = time.monotonic()
     last_forecast = t0
     for a in arrivals:
+        if abort_ev.is_set():
+            break
         now = time.monotonic()
         target = t0 + a.t
-        if target > now:
-            time.sleep(target - now)
+        if target > now and abort_ev.wait(target - now):
+            break
         base, scenario = resolved[a.stream][a.record_index]
         cells = int(
             base.grid.shape[0] * base.grid.shape[1] * base.grid.shape[2]
@@ -429,6 +593,12 @@ def run_soak(
     stop_collect.set()
     collector.join(timeout=600)
     elapsed = time.monotonic() - t0
+
+    # finalize AFTER shutdown (the engine's final serve_metrics_summary
+    # has landed) and BEFORE the soak_verdict event, so the ledger reads
+    # monitor_summary -> soak_verdict in causal order
+    aborted = abort_ev.is_set()
+    mon_info = mon.finalize() if mon is not None else None
 
     stats = engine.stats()
     summary = engine.metrics_summary()
@@ -488,17 +658,28 @@ def run_soak(
         "order_ok": order_ok[0],
         "accounting_ok": accounting_ok,
         "aot": stats["aot"],
+        # an aborted soak is judged on what it replayed: ``partial``
+        # flags the truncated schedule, ``aborted`` condemns the verdict
+        # (rc 1 in the CLI) — the early-termination contract
+        "aborted": aborted,
+        "partial": stats["submitted"] < len(arrivals),
         "ok": bool(
             accounting_ok
             and order_ok[0]
             and delivered_all
             and stalls_after_warmup == 0
+            and not aborted
         ),
         "summary": summary,
     }
+    if aborted:
+        verdict["abort_reason"] = "slo_burn"
+    if mon_info is not None:
+        verdict["monitor"] = mon_info
     obs.get().event(
         "soak_verdict",
         ok=verdict["ok"],
+        aborted=aborted,
         seed=seed,
         duration_s=verdict["duration_s"],
         submitted=verdict["submitted"],

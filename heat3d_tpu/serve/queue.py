@@ -29,8 +29,9 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
+import uuid
 from collections import OrderedDict
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -134,6 +135,93 @@ class _Pending:
     base: SolverConfig
     scenario: Scenario
     submitted_at: float
+    trace: Optional[Dict[str, Any]] = None
+
+
+def new_trace() -> Dict[str, Any]:
+    """Per-request trace context, minted at ``submit()`` (both
+    front-ends) and carried on the request through pack, dispatch,
+    execution, requeue, and delivery. Milestones are ``time.monotonic``
+    (immune to wall steps; one process, so comparable):
+
+    - ``t_submit`` — admission;
+    - ``packs`` — each time the request left the queue into a chunk
+      (one entry per attempt);
+    - ``exec`` — each successful device-execution window ``(t0, t1)``;
+    - ``requeues`` — each backend-loss requeue ``{t, attempt,
+      backoff_s}``.
+
+    At delivery :func:`build_chunk_results` folds the milestones into
+    causally-linked ``serve_span`` ledger events (the queue / pack /
+    compute / deliver decomposition ``heat3d obs trace`` prints and the
+    timeline's waterfall track renders)."""
+    return {
+        "id": uuid.uuid4().hex[:12],
+        "t_submit": time.monotonic(),
+        "packs": [],
+        "exec": [],
+        "requeues": [],
+    }
+
+
+def _emit_trace_spans(
+    trace: Dict[str, Any],
+    rid: int,
+    bucket: str,
+    stream: Optional[str],
+    now_mono: float,
+) -> None:
+    """One request's ``serve_span`` events, written at delivery. These
+    are POINT events carrying explicit wall-clock ``t0_wall``/``t1_wall``
+    bounds — per-request phases from concurrent bucket workers interleave
+    freely, which the ledger's span-nesting lint (correctly) rejects for
+    real ``kind=span`` records, so the waterfall gets its own field
+    contract instead."""
+    # one wall/monotonic offset for the whole request so phases butt
+    # exactly (each t_wall = t_mono + offset with the same offset)
+    off = time.time() - time.monotonic()
+
+    def phase(name, m0, m1, parent="request", **extra):
+        obs.get().event(
+            "serve_span",
+            trace_id=trace["id"],
+            request_id=rid,
+            span=name,
+            parent=parent,
+            bucket=bucket,
+            stream=stream,
+            t0_wall=round(m0 + off, 6),
+            t1_wall=round(m1 + off, 6),
+            span_dur_s=round(max(m1 - m0, 0.0), 6),
+            **extra,
+        )
+
+    t_sub = trace["t_submit"]
+    packs = trace["packs"]
+    execs = trace["exec"]
+    requeues = trace["requeues"]
+    phase(
+        "request", t_sub, now_mono, parent=None,
+        attempts=len(requeues) + 1,
+    )
+    first_pack = packs[0] if packs else now_mono
+    phase("queue", t_sub, first_pack)
+    if execs:
+        t_ex0, t_ex1 = execs[-1]
+        last_pack = packs[-1] if packs else t_ex0
+        phase("pack", last_pack, t_ex0)
+        phase("compute", t_ex0, t_ex1)
+        phase("deliver", t_ex1, now_mono)
+    for rq in requeues:
+        # the gap a backend loss cost this request: requeue -> the next
+        # time it left the queue (or delivery, if it never re-packed)
+        t_rq = rq["t"]
+        t_next = next((t for t in packs if t > t_rq), now_mono)
+        phase(
+            "requeue_gap", t_rq, t_next,
+            attempt=rq.get("attempt"),
+            backoff_s=rq.get("backoff_s"),
+        )
 
 
 def pad_batch(
@@ -192,24 +280,28 @@ def run_packed_batch(
 
 
 def build_chunk_results(
-    requests: List[Tuple[int, float]],
+    requests: List[Tuple],
     bucket: str,
     budgets: np.ndarray,
     fields,
     residuals,
     snapshots,
     stats: "ServeStats",
+    stream: Optional[str] = None,
 ) -> List[ServeResult]:
-    """``(request_id, submitted_at)`` pairs → delivered
+    """``(request_id, submitted_at[, trace])`` tuples → delivered
     :class:`ServeResult`s: the per-request latency observation,
-    ``serve_result`` ledger event, and result assembly (snapshot
-    slicing, residual conversion). Shared by the synchronous queue and
-    the async engine for the same reason as :func:`run_packed_batch` —
-    the delivered payload cannot diverge between front-ends if there is
-    only one assembler."""
+    ``serve_result`` ledger event, the request's ``serve_span`` trace
+    decomposition (when a trace context rode along), and result assembly
+    (snapshot slicing, residual conversion). Shared by the synchronous
+    queue and the async engine for the same reason as
+    :func:`run_packed_batch` — the delivered payload cannot diverge
+    between front-ends if there is only one assembler."""
     out: List[ServeResult] = []
     now = time.monotonic()
-    for i, (rid, submitted_at) in enumerate(requests):
+    for i, req in enumerate(requests):
+        rid, submitted_at = req[0], req[1]
+        trace = req[2] if len(req) > 2 else None
         latency = now - submitted_at
         stats.observe_result(bucket, latency)
         obs.get().event(
@@ -218,7 +310,13 @@ def build_chunk_results(
             steps=int(budgets[i]),
             batch_members=len(requests),
             queue_latency_s=round(latency, 6),
+            bucket=bucket,
+            trace_id=trace["id"] if trace else None,
         )
+        if trace is not None:
+            _emit_trace_spans(
+                trace, rid, bucket, trace.get("stream") or stream, now
+            )
         out.append(
             ServeResult(
                 request_id=rid,
@@ -470,16 +568,19 @@ class ScenarioQueue:
             )
         rid = self._next_id
         self._next_id += 1
+        trace = new_trace()
         self._pending[rid] = _Pending(
             request_id=rid,
             base=base,
             scenario=scenario,
-            submitted_at=time.monotonic(),
+            submitted_at=trace["t_submit"],
+            trace=trace,
         )
         self._stats.observe_depth(len(self._pending))
         obs.get().event(
             "serve_submit",
             request_id=rid,
+            trace_id=trace["id"],
             grid=list(base.grid.shape),
             stencil=base.stencil.kind,
             steps=scenario.steps,  # materialized above — never None here
@@ -564,6 +665,10 @@ class ScenarioQueue:
 
     def _execute(self, chunk: List[_Pending]) -> List[ServeResult]:
         base = chunk[0].base
+        t_pack = time.monotonic()
+        for p in chunk:
+            if p.trace is not None:
+                p.trace["packs"].append(t_pack)
         members = [p.scenario for p in chunk]
         padded = _padded_size(len(members), self.max_batch, self.batch_mesh)
         batch = pad_batch(base, members, padded)
@@ -583,6 +688,7 @@ class ScenarioQueue:
         budgets = np.asarray(
             [batch.member_steps(m) for m in range(len(batch))], np.int32
         )
+        t_ex0 = time.monotonic()
         with obs.get().span(
             "serve_batch", members=len(chunk), padded=padded
         ) as span:
@@ -592,11 +698,14 @@ class ScenarioQueue:
                 with_residuals=self.with_residuals,
             )
             span.add(steps_total=int(budgets.sum()))
+        t_ex1 = time.monotonic()
 
         for p in chunk:
             self._pending.pop(p.request_id, None)
+            if p.trace is not None:
+                p.trace["exec"].append((t_ex0, t_ex1))
         out = build_chunk_results(
-            [(p.request_id, p.submitted_at) for p in chunk],
+            [(p.request_id, p.submitted_at, p.trace) for p in chunk],
             bucket_s, budgets, fields, residuals, snapshots, self._stats,
         )
         self._stats.observe_depth(len(self._pending))

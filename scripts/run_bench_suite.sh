@@ -536,3 +536,80 @@ PYEOF
 else
   note "suite: soak smoke skipped (SKIP_SOAK_SMOKE=1)"
 fi
+
+# Monitored-soak smoke (informational; docs/OBSERVABILITY.md §8): the
+# live SLO burn-rate leg, forced to alert — an impossible latency
+# ceiling under --monitor --abort-on-burn must terminate the replay
+# early (rc 1) with >=1 slo_burn_alert plus monitor_start /
+# monitor_summary in the ledger and a machine-readable partial verdict
+# (aborted == true). Proves the alerting path end-to-end the way the
+# elastic smoke proves the failover path: by firing it. Always CPU.
+# Fails SOFT; SKIP_MONITOR_SMOKE=1 skips.
+if [[ -z "${SKIP_MONITOR_SMOKE:-}" ]]; then
+  MON_MIX="${OUT%.jsonl}.monitor_mix.json"
+  MON_LEDGER="${OUT%.jsonl}.monitor_ledger.jsonl"
+  rm -f "$MON_LEDGER"
+  cat > "$MON_MIX" <<'JSONEOF'
+{
+  "duration_s": 30,
+  "seed": 7,
+  "rate_hz": 3.0,
+  "engine": {"max_batch": 2, "workers": 1},
+  "monitor": {"interval_s": 0.25, "fast_window_s": 2, "slow_window_s": 4},
+  "slo": {"objectives": [
+    {"name": "impossible-p50", "kind": "serve_latency",
+     "percentile": 50, "max_s": 0.000001}
+  ]},
+  "streams": [
+    {"name": "tenant-a", "weight": 1,
+     "scenarios": [{"grid": 12, "steps": 3, "alpha": 0.5, "seed": 1}]}
+  ]
+}
+JSONEOF
+  MON_RC=0
+  MON_LINE=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
+    python -m heat3d_tpu.cli serve --loadgen "$MON_MIX" \
+    --monitor --abort-on-burn --verdict --ledger "$MON_LEDGER" \
+    2>>"$SUITE_LOG" | tail -n 1) || MON_RC=$?
+  # rc 1 is the EXPECTED outcome here (the soak is built to be aborted)
+  [[ "$MON_RC" -eq 1 ]] \
+    || note "suite: monitor smoke rc=$MON_RC (expected 1) — informational"
+  python - "$MON_LINE" "$MON_LEDGER" <<'PYEOF' \
+    || note "suite: monitor smoke verdict failed — informational"
+import json, sys
+try:
+    v = json.loads(sys.argv[1])["soak_verdict"]
+except Exception:
+    print(json.dumps({"monitor_smoke": {"ok": False, "error": "no verdict"}}))
+    sys.exit(1)
+alerts = opens = summaries = 0
+try:
+    with open(sys.argv[2]) as f:
+        for line in f:
+            try:
+                name = json.loads(line).get("event")
+            except Exception:
+                continue
+            alerts += name == "slo_burn_alert"
+            opens += name == "monitor_start"
+            summaries += name == "monitor_summary"
+except OSError:
+    pass
+mon = v.get("monitor") or {}
+ok = (
+    bool(v.get("aborted"))
+    and v.get("abort_reason") == "slo_burn"
+    and not v.get("ok")
+    and alerts >= 1 and opens == 1 and summaries == 1
+    and mon.get("alerts", 0) >= 1
+)
+print(json.dumps({"monitor_smoke": {
+    "ok": ok, "aborted": v.get("aborted"), "partial": v.get("partial"),
+    "alerts_in_ledger": alerts, "monitor": mon}}))
+sys.exit(0 if ok else 1)
+PYEOF
+else
+  note "suite: monitor smoke skipped (SKIP_MONITOR_SMOKE=1)"
+fi

@@ -23,6 +23,16 @@ through ``HEAT3D_FAULTS`` (the verdict must show the degraded window
 and the requeue, accounting must balance, zero post-warmup compile
 stalls, rc 0, and the committed row must pass the provenance lint);
 breach runs the same mix against an impossible inline SLO (rc 1).
+
+``monitor-pass DIR`` / ``monitor-abort DIR`` are the live-monitoring
+stages (ISSUE 17): abort proves ``--monitor --abort-on-burn`` against an
+impossible SLO terminates the replay early (rc 1, ``slo_burn_alert`` +
+partial verdict in the ledger); pass proves a healthy monitored soak —
+with mid-run chaos AND forced ledger rotation — finishes with zero
+alerts, the live evaluator's final state test-pinned equal to post-hoc
+``obs slo``, and a requeued request's trace surviving the degraded
+window end to end (one trace_id, ``requeue_gap`` span, ``obs trace``
+reproduces the decomposition).
 """
 
 import contextlib
@@ -289,13 +299,164 @@ def soak_stage(mode: str, work_dir: str):
         print("soak breach stage: OK (rc 1 on SLO breach)")
 
 
+def monitor_stage(mode: str, work_dir: str):
+    """``monitor-pass`` / ``monitor-abort``: the live-monitoring leg of
+    ISSUE 17. Abort: an impossible inline SLO under ``--monitor
+    --abort-on-burn`` must terminate the replay early (rc 1) with
+    ``slo_burn_alert`` + a machine-readable partial verdict. Pass: a
+    lenient SLO with mid-soak chaos runs to completion with ZERO
+    alerts, the monitor's final state PINNED equal to post-hoc ``obs
+    slo`` on the same (rotated!) ledger, and a requeued request's
+    trace_id surviving the degraded window end-to-end."""
+    spec_path = os.path.join(work_dir, "mix.json")
+    ledger = os.path.join(work_dir, f"ledger-{mode}.jsonl")
+    mix = _soak_mix()
+    mix["monitor"] = {
+        "interval_s": 0.2, "fast_window_s": 2, "slow_window_s": 4,
+    }
+    argv = ["--loadgen", spec_path, "--verdict", "--ledger", ledger,
+            "--monitor"]
+    if mode == "monitor-abort":
+        mix["slo"] = {
+            "objectives": [
+                {"name": "impossible-p50", "kind": "serve_latency",
+                 "percentile": 50, "max_s": 1e-9},
+            ]
+        }
+        argv.append("--abort-on-burn")
+    else:
+        # the chaos leg rides along: the requeued chunk must keep its
+        # trace through the degraded window (continuity assertion below)
+        os.environ["HEAT3D_FAULTS"] = "partial-device-loss:after=3:keep=2"
+        mix["slo"] = {
+            "objectives": [
+                {"name": "lenient-p95", "kind": "serve_latency",
+                 "percentile": 95, "max_s": 300.0},
+                {"name": "soak-degraded", "kind": "serve_degraded",
+                 "max_s": 60.0},
+            ]
+        }
+        # force rotation mid-soak: the tailer, the live evaluator and
+        # the post-hoc read must all survive segment rollover
+        os.environ["HEAT3D_LEDGER_MAX_MB"] = "0.02"
+    with open(spec_path, "w") as f:
+        json.dump(mix, f)
+
+    rc, out = _run_cli(argv)
+    verdict = json.loads(out.strip().splitlines()[-1])["soak_verdict"]
+    mon = verdict.get("monitor")
+    assert mon is not None, verdict
+
+    from heat3d_tpu.analysis.ledgerlint import check_file
+    from heat3d_tpu.obs.cli import main as obs_main, read_ledger
+    from heat3d_tpu.obs.ledger import ledger_segments
+
+    # the (possibly rotated) stream lints clean as ONE stream and reads
+    # back whole through the base path
+    assert check_file(ledger) == [], check_file(ledger)[:5]
+    events = read_ledger(ledger)
+    names = [e["event"] for e in events]
+    assert "monitor_start" in names, sorted(set(names))
+    assert "monitor_summary" in names, sorted(set(names))
+
+    if mode == "monitor-abort":
+        assert rc == 1, (rc, verdict)
+        assert verdict["aborted"] and not verdict["ok"], verdict
+        assert verdict["partial"], verdict
+        assert verdict["abort_reason"] == "slo_burn", verdict
+        assert mon["alerts"] >= 1 and mon["aborted"], mon
+        alerts = [e for e in events if e["event"] == "slo_burn_alert"]
+        assert alerts, sorted(set(names))
+        assert alerts[0]["objective"] == "impossible-p50", alerts[0]
+        assert alerts[0]["fast_burn"] >= 1.0, alerts[0]
+        (sv,) = [e for e in events if e["event"] == "soak_verdict"]
+        assert sv["aborted"] is True, sv
+        print("monitor abort stage: OK (rc 1, early abort, alert landed)")
+        return
+
+    # ---- monitor-pass ----
+    assert rc == 0, (rc, verdict, out)
+    assert verdict["ok"] and not verdict["aborted"], verdict
+    assert not verdict["partial"], verdict
+    assert mon["alerts"] == 0, mon
+    assert "slo_burn_alert" not in names
+    # rotation actually happened (the 50 KB cap is far below a traced
+    # soak ledger) and the segments chain base-last
+    segs = ledger_segments(ledger)
+    assert len(segs) >= 2, segs
+    assert segs[-1] == ledger, segs
+
+    # THE live/post-hoc agreement pin: the monitor_summary's final
+    # verdict must equal a fresh post-hoc evaluation of the same ledger
+    # through the same shared core
+    from heat3d_tpu.obs.perf import slo
+
+    spec = slo.validate_spec(dict(mix["slo"]), origin="test")
+    posthoc = slo.evaluate(events, spec)
+    (ms,) = [e for e in events if e["event"] == "monitor_summary"]
+    assert ms["final"] == posthoc["verdict"], (ms, posthoc["verdict"])
+    live_objs = {
+        o["name"]: (o["status"], o["burn_rate"]) for o in ms["objectives"]
+    }
+    post_objs = {
+        o["name"]: (o["status"], o["burn_rate"])
+        for o in posthoc["objectives"]
+    }
+    assert live_objs == post_objs, (live_objs, post_objs)
+
+    # trace continuity through the degraded path: the requeued chunk's
+    # requests keep ONE trace_id from submit through requeue to
+    # delivery, and the waterfall records the requeue gap
+    requeue_evs = [e for e in events if e["event"] == "serve_requeue"]
+    assert requeue_evs, sorted(set(names))
+    rq_rids = [rid for e in requeue_evs for rid in e["request_ids"]]
+    spans = [e for e in events if e["event"] == "serve_span"]
+    rid = next(
+        r for r in rq_rids
+        if any(s["request_id"] == r and s["span"] == "request"
+               for s in spans)
+    )
+    rid_spans = [s for s in spans if s["request_id"] == rid]
+    tids = {s["trace_id"] for s in rid_spans}
+    assert len(tids) == 1, (rid, tids)
+    span_names = {s["span"] for s in rid_spans}
+    assert "requeue_gap" in span_names, (rid, span_names)
+    assert {"request", "queue", "compute", "deliver"} <= span_names
+    (root,) = [s for s in rid_spans if s["span"] == "request"]
+    assert root["attempts"] >= 2, root
+    # the submit event carries the same trace (minted at submit, not
+    # at delivery)
+    sub = next(
+        e for e in events
+        if e["event"] == "serve_submit" and e.get("request_id") == rid
+    )
+    assert sub["trace_id"] == root["trace_id"], (sub, root)
+
+    # the CLI decomposition reproduces it (rc 0, requeue annotated)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        trc = obs_main(["trace", ledger, str(rid), "--json"])
+    assert trc == 0, (trc, buf.getvalue())
+    rep = json.loads(buf.getvalue())
+    assert rep["trace_id"] == root["trace_id"], rep
+    assert rep["attempts"] >= 2 and rep["requeues"], rep
+    assert any(p["span"] == "requeue_gap" for p in rep["phases"]), rep
+    print(
+        "monitor pass stage: OK (0 alerts, live==post-hoc, trace "
+        "survives requeue, rotation lints clean)"
+    )
+
+
 def main():
     import jax
 
     ndev = len(jax.devices())
     assert ndev == 4, f"need a 4-device CPU mesh, got {ndev}"
     if len(sys.argv) > 1:
-        soak_stage(sys.argv[1], sys.argv[2])
+        if sys.argv[1].startswith("monitor-"):
+            monitor_stage(sys.argv[1], sys.argv[2])
+        else:
+            soak_stage(sys.argv[1], sys.argv[2])
         print("SOAK STAGE OK")
         return
     check_sync_queue_backpressure()

@@ -1,0 +1,377 @@
+"""Live observability units (ISSUE 17): size-capped ledger rotation,
+incremental tailing across rotations, the streaming ledger lint, the
+windowed burn-rate evaluator (and its live == post-hoc pin against the
+shared SLO core), per-request trace reconstruction (``obs trace``), the
+``obs watch`` view, and the Perfetto per-request waterfall track.
+
+The end-to-end legs — a monitored soak aborting early on burn, and the
+agreement pin over a real chaos run — live in tests/soak_checks.py
+(``monitor-pass`` / ``monitor-abort``, driven by test_serve_soak.py);
+this file pins the pieces in isolation, fast, with no devices.
+"""
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+from heat3d_tpu import obs
+from heat3d_tpu.analysis.ledgerlint import StreamChecker, check_file
+from heat3d_tpu.obs.burn import BurnEvaluator
+from heat3d_tpu.obs.cli import main as obs_main, read_ledger
+from heat3d_tpu.obs.ledger import ledger_segments
+from heat3d_tpu.obs.tailer import LedgerTailer
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger_state(monkeypatch):
+    monkeypatch.delenv("HEAT3D_LEDGER", raising=False)
+    monkeypatch.delenv("HEAT3D_LEDGER_MAX_MB", raising=False)
+    obs.deactivate()
+    yield
+    obs.deactivate()
+
+
+# ---- rotation ---------------------------------------------------------------
+
+
+def test_rotation_rolls_segments_and_reads_back_whole(tmp_path, monkeypatch):
+    """HEAT3D_LEDGER_MAX_MB rolls the base file aside at the cap; the
+    segments chain oldest-first with the base last, and read_ledger /
+    check_file treat the chain as the one continuous stream it is."""
+    monkeypatch.setenv("HEAT3D_LEDGER_MAX_MB", "0.001")  # 1 KB
+    p = str(tmp_path / "led.jsonl")
+    obs.activate(p, meta={"entry": "test"})
+    for i in range(60):
+        obs.get().event("fault_injected", kind_="unit-test", step=i)
+    obs.deactivate(rc=0)
+
+    segs = ledger_segments(p)
+    assert len(segs) >= 3, segs
+    assert segs[-1] == p and all(os.path.exists(s) for s in segs), segs
+    # rolled segments are named base.N.jsonl, in rotation order
+    stem = str(tmp_path / "led")
+    assert segs[:-1] == [f"{stem}.{i}.jsonl" for i in range(len(segs) - 1)]
+
+    events = read_ledger(p)
+    faults = [e for e in events if e["event"] == "fault_injected"]
+    assert len(faults) == 60
+    assert [e["step"] for e in faults] == list(range(60))
+    # seq stays strictly increasing across the rollover — one stream
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert check_file(p) == [], check_file(p)[:5]
+
+
+def test_rotation_disabled_without_env(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    obs.activate(p)
+    for i in range(60):
+        obs.get().event("fault_injected", kind_="unit-test", step=i)
+    obs.deactivate(rc=0)
+    assert ledger_segments(p) == [p]
+
+
+# ---- incremental tailing ----------------------------------------------------
+
+
+def test_tailer_is_incremental_and_rotation_proof(tmp_path, monkeypatch):
+    """Each poll returns exactly the events appended since the last one
+    — across forced rotations, no duplicates, no loss."""
+    monkeypatch.setenv("HEAT3D_LEDGER_MAX_MB", "0.001")
+    p = str(tmp_path / "led.jsonl")
+    obs.activate(p)
+    tailer = LedgerTailer(p)
+    seen = []
+    for i in range(50):
+        obs.get().event("fault_injected", kind_="unit-test", step=i)
+        if i % 7 == 0:
+            seen.extend(tailer.poll())
+    obs.deactivate(rc=0)
+    seen.extend(tailer.poll())
+    assert tailer.poll() == []  # drained: nothing new, nothing repeated
+
+    assert len(ledger_segments(p)) >= 2  # rotation really happened
+    steps = [e["step"] for e in seen if e["event"] == "fault_injected"]
+    assert steps == list(range(50))
+    # the tailed stream is byte-equivalent to a post-hoc full read
+    assert [e["seq"] for e in seen] == [e["seq"] for e in read_ledger(p)]
+
+
+def test_tailer_buffers_partial_lines(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    with open(p, "w") as f:
+        f.write('{"a": 1}\n{"b": ')
+        f.flush()
+        t = LedgerTailer(p)
+        assert t.poll_lines() == ['{"a": 1}']
+        f.write("2}\n")
+        f.flush()
+        assert t.poll_lines() == ['{"b": 2}']
+
+
+# ---- streaming lint ---------------------------------------------------------
+
+
+def test_stream_checker_flags_defects_once(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    obs.activate(p)
+    obs.get().event("fault_injected", kind_="unit-test", step=0)
+    obs.deactivate(rc=0)
+    lines = [ln for ln in open(p).read().splitlines() if ln]
+
+    c = StreamChecker()
+    bad = []
+    for ln in lines:
+        bad.extend(c.feed(ln))
+    assert bad == [], bad  # a well-formed stream feeds clean
+    assert c.lines_seen == len(lines)
+
+    # a seq regression (append-only violated) is flagged, with the
+    # virtual line number, and the stream recovers on the next good line
+    rec = json.loads(lines[-1])
+    bad = c.feed(json.dumps(rec))  # same seq again: not strictly above
+    assert len(bad) == 1 and "seq" in bad[0][1], bad
+    assert bad[0][0] == len(lines) + 1
+    assert c.feed(json.dumps(dict(rec, seq=rec["seq"] + 1))) == []
+
+    c2 = StreamChecker()
+    assert c2.feed("not json {")  # malformed line is a defect immediately
+
+
+# ---- burn-rate evaluation ---------------------------------------------------
+
+SPEC = {
+    "objectives": [
+        {"name": "p95-lat", "kind": "serve_latency", "percentile": 95,
+         "max_s": 0.1},
+    ]
+}
+
+
+def _result(ts, lat, bucket="b0"):
+    return {"ts": ts, "event": "serve_result", "kind": "point",
+            "bucket": bucket, "queue_latency_s": lat}
+
+
+def test_burn_alerts_only_when_both_windows_burn():
+    from heat3d_tpu.obs.perf.slo import validate_spec
+
+    spec = validate_spec(dict(SPEC), origin="test")
+    be = BurnEvaluator(spec, fast_s=10.0, slow_s=60.0, threshold=1.0)
+
+    # healthy traffic fills the slow window (2 Hz: dense enough that a
+    # short burst stays under the slow window's p95)
+    be.consume([_result(980.0 + 0.5 * i, 0.01) for i in range(120)])
+    rep = be.evaluate()
+    assert rep["alerting"] == [], rep
+    (o,) = rep["objectives"]
+    assert o["fast"]["status"] == "ok" and not o["alerting"]
+
+    # a breach burst inside the fast window: fast burns hot, but the
+    # slow window's p95 still rides the healthy majority — no page
+    be.consume([_result(1040.0 + 0.1 * i, 0.5) for i in range(3)])
+    rep = be.evaluate()
+    (o,) = rep["objectives"]
+    assert o["fast"]["burn"] >= 1.0, o
+    assert rep["alerting"] == [], rep
+
+    # sustained breach: both windows over threshold → alert
+    be.consume([_result(1041.0 + i, 0.5) for i in range(59)])
+    rep = be.evaluate()
+    assert rep["alerting"] == ["p95-lat"], rep
+    (o,) = rep["objectives"]
+    assert o["slow"]["burn"] >= 1.0 and o["alerting"]
+
+
+def test_burn_state_is_bounded_by_the_slow_window():
+    from heat3d_tpu.obs.perf.slo import validate_spec
+
+    be = BurnEvaluator(
+        validate_spec(dict(SPEC), origin="test"), fast_s=5.0, slow_s=10.0
+    )
+    be.consume([_result(float(i), 0.01) for i in range(10_000)])
+    held = sum(len(dq) for dq in be._lat.values())
+    assert held <= 12, held  # pruned to the slow window, not the run
+
+
+def test_burn_final_verdict_matches_posthoc_evaluate(tmp_path):
+    """THE shared-core pin, in isolation: feed one synthetic ledger to
+    the live evaluator incrementally and to post-hoc slo.evaluate whole
+    — identical verdict, per-objective status AND burn rate."""
+    from heat3d_tpu.obs.perf import slo
+
+    spec = slo.validate_spec(
+        {
+            "objectives": [
+                {"name": "p95-lat", "kind": "serve_latency",
+                 "percentile": 95, "max_s": 0.1},
+                {"name": "degraded", "kind": "serve_degraded",
+                 "max_s": 1.0},
+            ]
+        },
+        origin="test",
+    )
+    p = str(tmp_path / "led.jsonl")
+    obs.activate(p)
+    for i in range(30):
+        obs.get().event(
+            "serve_result", request_id=i, bucket="b0",
+            queue_latency_s=0.01 + 0.001 * i,
+        )
+    obs.get().event(
+        "serve_metrics_summary",
+        buckets={"b0": {"count": 30, "p50_s": 0.02, "p95_s": 0.038,
+                        "max_s": 0.039}},
+        depth_max=3, degraded=False, degraded_s=0.25, requeues=1,
+    )
+    obs.deactivate(rc=0)
+
+    events = read_ledger(p)
+    be = BurnEvaluator(spec, fast_s=5.0, slow_s=30.0)
+    for e in events:  # one-at-a-time: the tailer's worst case
+        be.consume([e])
+    live = be.final_verdict()
+    posthoc = slo.evaluate(events, spec)
+    assert live["verdict"] == posthoc["verdict"] == "pass"
+    pin = lambda rep: [  # noqa: E731
+        (o["name"], o["status"], o["burn_rate"], o["value"])
+        for o in rep["objectives"]
+    ]
+    assert pin(live) == pin(posthoc)
+
+
+# ---- trace reconstruction (obs trace) ---------------------------------------
+
+
+def _write_trace_ledger(tmp_path):
+    """A delivered request's serve_span set, via the real emitter."""
+    import time
+
+    from heat3d_tpu.serve.queue import _emit_trace_spans, new_trace
+
+    p = str(tmp_path / "led.jsonl")
+    obs.activate(p)
+    now = time.monotonic()
+    trace = new_trace()
+    # submit -> pack -> (backend loss) requeue -> re-pack -> exec -> done
+    trace["t_submit"] = now - 2.0
+    trace["packs"] = [now - 1.8, now - 0.9]
+    trace["requeues"].append(
+        {"t": now - 1.5, "attempt": 1, "backoff_s": 0.5}
+    )
+    trace["exec"].append((now - 0.8, now - 0.1))
+    _emit_trace_spans(trace, 7, bucket="b0", stream="tenant-a",
+                      now_mono=now)
+    obs.deactivate(rc=0)
+    return p, trace["id"]
+
+
+def test_obs_trace_reconstructs_the_decomposition(tmp_path):
+    p, tid = _write_trace_ledger(tmp_path)
+    spans = [e for e in read_ledger(p) if e["event"] == "serve_span"]
+    assert {s["span"] for s in spans} == {
+        "request", "queue", "pack", "compute", "deliver", "requeue_gap"
+    }
+    assert {s["trace_id"] for s in spans} == {tid}
+    (root,) = [s for s in spans if s["span"] == "request"]
+    assert root["parent"] is None and root["attempts"] == 2
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = obs_main(["trace", p, "7", "--json"])
+    assert rc == 0
+    rep = json.loads(buf.getvalue())
+    assert rep["trace_id"] == tid and rep["request_id"] == 7
+    assert rep["attempts"] == 2 and rep["total_s"] == pytest.approx(2.0, rel=0.1)
+    by_span = {ph["span"]: ph for ph in rep["phases"]}
+    assert by_span["requeue_gap"]["attempt"] == 1
+    assert by_span["requeue_gap"]["dur_s"] == pytest.approx(0.6, abs=0.01)
+    assert by_span["compute"]["dur_s"] == pytest.approx(0.7, abs=0.01)
+    # the phases tile the request's wall window (the only uncovered gap
+    # is the lost first execution attempt: pack1 -> the backend loss)
+    share = sum(
+        ph["share"] for ph in rep["phases"] if ph["span"] != "request"
+    )
+    assert share == pytest.approx(0.85, abs=0.05), rep["phases"]
+
+    # lookup by trace id hits the same request
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert obs_main(["trace", p, tid, "--json"]) == 0
+    assert json.loads(buf.getvalue())["request_id"] == 7
+
+    # human rendering exits 0 too
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert obs_main(["trace", p, "7"]) == 0
+
+
+def test_obs_trace_unknown_request_is_rc_1(tmp_path):
+    p, _ = _write_trace_ledger(tmp_path)
+    with contextlib.redirect_stdout(io.StringIO()):
+        with contextlib.redirect_stderr(io.StringIO()):
+            assert obs_main(["trace", p, "999"]) == 1
+
+
+# ---- watch view -------------------------------------------------------------
+
+
+def test_obs_watch_once(tmp_path):
+    p = str(tmp_path / "led.jsonl")
+    obs.activate(p)
+    for i in range(10):
+        obs.get().event("serve_submit", request_id=i, queue_depth=i % 3)
+        obs.get().event(
+            "serve_result", request_id=i, bucket="b0",
+            queue_latency_s=0.02,
+        )
+    obs.get().event("serve_requeue", request_ids=[3], attempt=1)
+    obs.deactivate(rc=0)
+
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps(SPEC))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = obs_main(["watch", p, "--once", "--spec", str(spec),
+                       "--json"])
+    assert rc == 0
+    status = json.loads(buf.getvalue())
+    assert status["events_seen"] >= 21
+    assert status["delivery_hz"] > 0 and status["queue_depth"] is not None
+    assert status["buckets"]["b0"]["count"] == 10
+    assert status["flags"].get("serve_requeue") == 1
+    (o,) = status["burn"]["objectives"]
+    assert o["name"] == "p95-lat" and not o["alerting"]
+
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert obs_main(["watch", p, "--once", "--spec", str(spec)]) == 0
+
+
+# ---- Perfetto waterfall -----------------------------------------------------
+
+
+def test_chrome_trace_gets_a_request_waterfall_track(tmp_path):
+    from heat3d_tpu.obs.perf.timeline import timeline_events, to_chrome_trace
+
+    p, tid = _write_trace_ledger(tmp_path)
+    trace = to_chrome_trace(timeline_events(read_ledger(p)))
+    names = {
+        t["args"]["name"] for t in trace["traceEvents"]
+        if t["ph"] == "M" and t["name"] == "process_name"
+    }
+    assert "requests (serve traces)" in names, names
+    slices = [
+        t for t in trace["traceEvents"]
+        if t["ph"] == "X" and t["args"].get("trace_id") == tid
+    ]
+    assert {s["name"] for s in slices} >= {
+        "request", "queue", "compute", "deliver", "requeue_gap"
+    }
+    # one tid for the whole request, root slice containing its phases
+    assert len({s["tid"] for s in slices}) == 1
+    (root,) = [s for s in slices if s["name"] == "request"]
+    for s in slices:
+        assert s["ts"] >= root["ts"] - 1e-6
+        assert s["ts"] + s["dur"] <= root["ts"] + root["dur"] + 1e-6

@@ -17,6 +17,13 @@ Acceptance battery for ISSUE 16. Tiers:
   mid-run ``partial-device-loss`` (verdict accounting, degraded window
   judged with data, zero post-warmup compile stalls, rc 0 pass /
   rc 1 breach, committed row passing the provenance lint).
+
+ISSUE 17 adds the live-monitoring stages (``monitor-pass`` /
+``monitor-abort``): the burn-rate monitor aborting a doomed soak early
+with a partial verdict, and a healthy monitored soak whose final live
+state is pinned equal to post-hoc ``obs slo`` while a requeued
+request's trace survives the degraded window and forced ledger
+rotation.
 """
 
 import os
@@ -220,6 +227,35 @@ def test_short_soak_with_midrun_device_loss_tier1(stage, tmp_path):
     degraded window judged by serve_degraded WITH data, zero compile
     stalls after warmup, and the CLI verdict exits 0 on pass (row
     passing the provenance lint) / 1 on an impossible inline SLO."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(HERE, "soak_checks.py"),
+            stage,
+            str(tmp_path),
+        ],
+        env=_subproc_env(tmp_path),
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"{stage} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "SOAK STAGE OK" in proc.stdout
+
+
+@pytest.mark.parametrize("stage", ["monitor-pass", "monitor-abort"])
+def test_monitored_soak_tier1(stage, tmp_path):
+    """THE live-monitoring acceptance (ISSUE 17). ``monitor-abort``: an
+    impossible SLO under ``--monitor --abort-on-burn`` terminates the
+    replay early — rc 1, ``slo_burn_alert`` in the ledger, verdict
+    marked aborted/partial with ``abort_reason == "slo_burn"``.
+    ``monitor-pass``: a lenient SLO with mid-run device loss AND forced
+    ledger rotation runs to completion with zero alerts, the monitor's
+    final state equal to post-hoc ``obs slo`` on the same ledger, and a
+    requeued request keeping one trace_id end to end (``obs trace``
+    reproducing the decomposition, requeue gap included)."""
     proc = subprocess.run(
         [
             sys.executable,
