@@ -124,6 +124,18 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
     "slo_verdict": {"kind": "point", "module": "obs/perf/slo.py",
                     "desc": "SLO evaluation: verdict + per-objective "
                             "burn rates"},
+    # comm observatory (obs/comm/, docs/OBSERVABILITY.md §9)
+    "comm_probe": {"kind": "point", "module": "obs/comm/probe.py",
+                   "desc": "one probed halo link (axis, direction, "
+                           "sub_block): plan-predicted bytes joined to "
+                           "measured p50 time -> GB/s"},
+    "clock_align": {"kind": "point", "module": "obs/perf/merge.py",
+                    "desc": "merge --align applied: anchor event, "
+                            "per-source offsets, confidence interval"},
+    "adjudicate_verdict": {"kind": "point", "module":
+                           "obs/comm/adjudicate.py",
+                           "desc": "POD_RUNBOOK A/B stage verdicts "
+                                   "(pass/fail/no-data per stage + rc)"},
     # exchange plans (parallel/plan.py)
     "exchange_plan_built": {"kind": "point", "module": "parallel/plan.py",
                             "desc": "persistent exchange plan constructed "
@@ -296,6 +308,12 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
                            "desc": "VPU peak override for roofline"},
     "HEAT3D_CKPT_VERIFY": {"module": "utils/checkpoint.py",
                            "desc": "0 skips shard CRC verification"},
+    "HEAT3D_COMM_PROBE": {"module": "obs/comm/probe.py",
+                          "desc": "1 runs the per-link halo probe after "
+                                  "bench_halo rows (comm_probe events)"},
+    "HEAT3D_COMM_PROBE_ITERS": {"module": "obs/comm/probe.py",
+                                "desc": "timed samples per probed link "
+                                        "(default 5)"},
     "HEAT3D_PROBE_TIMEOUT": {"module": "utils/backendprobe.py",
                              "desc": "per-probe budget seconds (default 60)"},
     "HEAT3D_COORDINATOR": {"module": "parallel/distributed.py",

@@ -528,6 +528,18 @@ def bench_halo(
         **halo_cost,
     }
     _ledger_bench_row(row)
+    # opt-in per-link probe (HEAT3D_COMM_PROBE): time each (axis,
+    # direction, sub-block) collective as its own micro-program and emit
+    # comm_probe rows beside this bench row — predicted-vs-achieved GB/s
+    # per link (docs/OBSERVABILITY.md §9). maybe_probe is env-gated and
+    # fails soft; the import guard covers torn installs the same way the
+    # other telemetry on this row does.
+    try:
+        from heat3d_tpu.obs.comm.probe import maybe_probe
+
+        maybe_probe(cfg)
+    except Exception as e:  # noqa: BLE001 - telemetry fails soft
+        print(f"bench: comm probe skipped ({e})", file=sys.stderr)
     return row
 
 

@@ -11,6 +11,7 @@ Subcommands::
     heat3d obs merge LEDGERS... [...]          # multihost timeline join (obs/perf/merge)
     heat3d obs timeline LEDGERS... [...]       # Chrome-trace export + drift/stragglers (obs/perf/timeline)
     heat3d obs slo LEDGER [...]                # SLO burn-rate verdict (obs/perf/slo)
+    heat3d obs adjudicate INPUTS... [...]      # POD_RUNBOOK A/B stage verdicts (obs/comm/adjudicate)
 
 ``summary`` is the operator's post-mortem view: for each run segment in
 the ledger it prints the invocation, a span-duration table (count, total,
@@ -72,6 +73,11 @@ NOTABLE = (
     "slo_burn_alert",
     "monitor_summary",
     "timeline_export",
+    # comm observatory (comm_probe is deliberately absent, like
+    # serve_span: one row per link per probe pass would drown the
+    # timeline — the per-link table renders them instead)
+    "clock_align",
+    "adjudicate_verdict",
     "run_end",
     "ledger_close",
 )
@@ -408,6 +414,15 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
     for line in ensemble_lines(events):
         print(line, file=out)
 
+    # comm observatory: per-link probe table (docs/OBSERVABILITY.md §9)
+    try:
+        from heat3d_tpu.obs.comm.report import comm_lines
+
+        for line in comm_lines(events):
+            print(line, file=out)
+    except Exception:  # noqa: BLE001 - a summary section must not kill summary
+        pass
+
     # drift/straggler section: rolling-baseline step-time anomalies
     # (obs/perf/timeline.detect_anomalies — regress's tolerance bands);
     # fails soft like every other summary section
@@ -445,7 +460,7 @@ def summarize_run(run_id: str, events: List[Dict[str, Any]], out=None) -> None:
                 "span", "delta_pct", "events", "streams",
                 "direction", "old_mesh", "new_mesh", "survivors",
                 "restitch_s", "mesh", "degraded_s", "bucket", "attempt",
-                "backoff_s",
+                "backoff_s", "anchor_event", "ci_s", "stages",
             )
             if k in r
         ]
@@ -738,14 +753,39 @@ def cmd_watch(args) -> int:
         time.monotonic() + args.duration if args.duration > 0 else None
     )
     status: Dict[str, Any] = {}
+    comm_events: List[Dict[str, Any]] = []
     try:
         while True:
-            be.consume(tailer.poll())
+            batch = tailer.poll()
+            be.consume(batch)
+            # comm observatory: accumulate the probe rows seen so far and
+            # render the per-link table under the burn block (fail-soft,
+            # like the summary section)
+            comm_events.extend(
+                r
+                for r in batch
+                if isinstance(r, dict) and r.get("event") == "comm_probe"
+            )
             status = be.status()
             if args.as_json:
+                if comm_events:
+                    try:
+                        from heat3d_tpu.obs.comm.report import comm_link_stats
+
+                        status["comm"] = comm_link_stats(comm_events)
+                    except Exception:  # noqa: BLE001 - fails soft
+                        pass
                 print(json.dumps(status))
             else:
-                for line in _watch_block(status):
+                lines = _watch_block(status)
+                if comm_events:
+                    try:
+                        from heat3d_tpu.obs.comm.report import comm_lines
+
+                        lines += comm_lines(comm_events)
+                    except Exception:  # noqa: BLE001 - fails soft
+                        pass
+                for line in lines:
                     print(line)
             sys.stdout.flush()
             if args.once or (
@@ -759,18 +799,22 @@ def cmd_watch(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    # the perf subcommands own their full argparse surfaces
-    # (obs/perf/{roofline,regress,merge}.main); dispatch before the ledger
-    # parser so their flags don't have to round-trip through it
+    # these subcommands own their full argparse surfaces
+    # (obs/perf/*.main, obs/comm/adjudicate.main); dispatch before the
+    # ledger parser so their flags don't have to round-trip through it
     argv_l = list(sys.argv[1:] if argv is None else argv)
-    if argv_l and argv_l[0] in (
-        "roofline", "regress", "merge", "timeline", "slo"
-    ):
+    owned = {
+        "roofline": "heat3d_tpu.obs.perf.roofline",
+        "regress": "heat3d_tpu.obs.perf.regress",
+        "merge": "heat3d_tpu.obs.perf.merge",
+        "timeline": "heat3d_tpu.obs.perf.timeline",
+        "slo": "heat3d_tpu.obs.perf.slo",
+        "adjudicate": "heat3d_tpu.obs.comm.adjudicate",
+    }
+    if argv_l and argv_l[0] in owned:
         import importlib
 
-        mod = importlib.import_module(
-            f"heat3d_tpu.obs.perf.{argv_l[0]}"
-        )
+        mod = importlib.import_module(owned[argv_l[0]])
         return mod.main(argv_l[1:])
 
     p = argparse.ArgumentParser(
@@ -880,6 +924,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "slo", add_help=False,
         help="service-level objectives: burn-rate verdict over serve "
         "latency buckets, step-time and halo-share ceilings",
+    )
+    sub.add_parser(
+        "adjudicate", add_help=False,
+        help="POD_RUNBOOK A/B stage verdicts (halo_plan / halo_order / "
+        "slab widths) from bench rows or merged ledgers",
     )
 
     args = p.parse_args(argv_l)

@@ -1,0 +1,26 @@
+"""The communication observatory (docs/OBSERVABILITY.md §9).
+
+Makes every halo transfer individually attributable and turns merged pod
+ledgers into machine-readable campaign verdicts:
+
+- :mod:`~heat3d_tpu.obs.comm.probe` — the opt-in ``HEAT3D_COMM_PROBE``
+  per-link probe: one micro-program per (axis, direction, sub-block)
+  collective, timed with honest blocking semantics (force_sync + RTT
+  subtraction), emitted as ``comm_probe`` ledger events carrying the
+  ExchangePlan's own predicted bytes so every link reports
+  predicted-vs-achieved GB/s. Imports jax — keep it out of this
+  package's import path.
+- :mod:`~heat3d_tpu.obs.comm.report` — pure (jax-free) aggregation of
+  ``comm_probe`` events into the per-link table ``obs summary`` and
+  ``obs watch`` render.
+- :mod:`~heat3d_tpu.obs.comm.adjudicate` — ``heat3d obs adjudicate``:
+  one command from merged ledgers / bench rows to the POD_RUNBOOK stage
+  verdicts (halo_plan, halo_order, slab widths) through the
+  ``tune/decide.py`` pairing logic; rc semantics match ``obs regress``
+  (1 only on a ``fail`` verdict).
+
+Like :mod:`heat3d_tpu.obs` itself, importing this package must stay
+cheap and jax-free (the obs CLI dispatches through it on machines with
+no accelerator stack warm) — submodules that need jax import it at
+their own module level and are imported lazily by their consumers.
+"""

@@ -1,0 +1,337 @@
+"""Opt-in per-link halo probe (``HEAT3D_COMM_PROBE``).
+
+The step programs attribute exchange time to per-(axis, direction,
+sub-block) named scopes (parallel/halo.py, parallel/plan.py), but scope
+attribution needs a profiler capture — and a fused program cannot tell
+you which *link* is slow from wall clocks alone. This probe answers that
+with direct measurement: for every link the :class:`ExchangePlan`'s
+schedule would exercise — one (axis, direction) pair per mesh axis in
+monolithic mode, one per sub-block in partitioned mode — it compiles a
+separate micro-program (a device-side ``fori_loop`` of back-to-back
+``ppermute`` of exactly that link's face sub-block), times it with the
+honest blocking semantics the benches use (``force_sync`` readback, RTT
+subtraction, trip-count calibration so device time swamps the host round
+trip), and emits one ``comm_probe`` ledger event per link carrying the
+plan's OWN predicted bytes for that message — so every link reports
+predicted-vs-achieved GB/s and the merged-ledger straggler detector
+(``link_straggler`` in obs/perf/timeline.py) can name the slow link
+across hosts.
+
+Honesty caveats, recorded on every row: per-link micro-programs time
+each collective in ISOLATION — the production exchange pipelines links
+(partitioned early-bird sends overlap sub-blocks), so the sum of probed
+link times is an upper bound on exchange latency, not a reconstruction
+of it. Rows where the host round trip dominates carry
+``rtt_dominated: true`` just like bench rows.
+
+Activation: ``HEAT3D_COMM_PROBE=1`` makes ``bench_halo`` run the probe
+after its row (fail-soft — a probe failure never kills a bench);
+``python -m heat3d_tpu.obs.comm.probe`` runs it standalone (the CLI is
+its own opt-in). ``HEAT3D_COMM_PROBE_ITERS`` overrides the timed-sample
+count (default ``5``).
+
+This module imports jax at module level — consumers that must stay
+jax-free (obs/cli.py) import it lazily.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from heat3d_tpu import obs
+from heat3d_tpu.core.config import GridConfig, MeshConfig, SolverConfig
+from heat3d_tpu.obs.trace import named_phase
+from heat3d_tpu.parallel.plan import (
+    ExchangePlan,
+    effective_halo_plan,
+    partition_bounds,
+    plan_for,
+)
+from heat3d_tpu.parallel.topology import build_mesh
+from heat3d_tpu.utils.compat import shard_map
+from heat3d_tpu.utils.timing import (
+    calibrate_trip_count,
+    force_sync,
+    honest_time,
+    percentile,
+    sync_overhead,
+)
+
+ENV_COMM_PROBE = "HEAT3D_COMM_PROBE"
+ENV_COMM_PROBE_ITERS = "HEAT3D_COMM_PROBE_ITERS"
+DEFAULT_ITERS = 5
+
+
+def comm_probe_enabled() -> bool:
+    """True when ``HEAT3D_COMM_PROBE`` opts the process into the per-link
+    probe (``0``/empty/unset stay off — the probe adds per-link compiles
+    and timed loops, never free)."""
+    return os.environ.get(ENV_COMM_PROBE, "") not in ("", "0")
+
+
+def probe_iters(default: int = DEFAULT_ITERS) -> int:
+    """Timed samples per link (``HEAT3D_COMM_PROBE_ITERS`` override;
+    malformed values fall back — observability never raises over an env
+    typo)."""
+    raw = os.environ.get(ENV_COMM_PROBE_ITERS)
+    if raw is None or raw == "":
+        return default
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return default
+
+
+def probe_links(
+    plan: ExchangePlan, local_shape, itemsize: int
+) -> List[Dict[str, Any]]:
+    """Enumerate the links ``plan`` would exercise on a block of
+    ``local_shape``, with the plan's own predicted bytes per message.
+
+    Mirrors :meth:`ExchangePlan.traffic` exactly — progressive face
+    extension under axis ordering, the partition granularity floor — so
+    the per-link ``bytes_predicted`` sum to the ``plan_bytes_per_device``
+    the bench rows record (the tests pin that identity). Size-1 axes
+    have no remote party and yield no links. Pure Python — no jax.
+    """
+    ext = list(local_shape)
+    w = plan.width
+    links: List[Dict[str, Any]] = []
+    for spec in plan.axis_specs:
+        if spec.size > 1:
+            face_shape = [w if d == spec.axis else ext[d] for d in range(3)]
+            if plan.mode == "partitioned":
+                nparts = plan._face_partitions(face_shape, itemsize)
+            else:
+                nparts = 1
+            bounds = partition_bounds(face_shape[spec.part_dim], nparts)
+            for direction, perm in (
+                # "lo" = the transfer that fills my LOW ghost (the low
+                # neighbor's high face, shifted up) — same orientation as
+                # the halo.<axis>.lo scope in parallel/halo.py
+                ("lo", spec.perm_up),
+                ("hi", spec.perm_down),
+            ):
+                for i, (a, b) in enumerate(bounds):
+                    sub = list(face_shape)
+                    sub[spec.part_dim] = b - a
+                    elems = sub[0] * sub[1] * sub[2]
+                    sub_block = i if len(bounds) > 1 else None
+                    scope = f"halo.{spec.name}.{direction}" + (
+                        f".p{i}" if sub_block is not None else ""
+                    )
+                    links.append(
+                        {
+                            "axis": spec.axis,
+                            "axis_name": spec.name,
+                            "direction": direction,
+                            "sub_block": sub_block,
+                            "sub_shape": tuple(sub),
+                            "bytes_predicted": elems * itemsize,
+                            "scope": scope,
+                            "perm": perm,
+                        }
+                    )
+        if plan.halo_order == "axis":
+            ext[spec.axis] += 2 * w
+    return links
+
+
+def _link_program(mesh, axis_names, axis_name: str, perm, scope: str):
+    """One link's micro-program: jitted shard_map'd fori_loop of ``n``
+    back-to-back ppermutes of the link's face sub-block (carry = the
+    block, so no transfer can be DCE'd), under the link's named scope."""
+    spec_p = P(*axis_names)
+
+    def _loop(f, n):
+        def body(_, x):
+            with named_phase(scope):
+                return jax.lax.ppermute(x, axis_name, perm)
+
+        return jax.lax.fori_loop(0, n, body, f)
+
+    return jax.jit(
+        shard_map(
+            _loop,
+            mesh=mesh,
+            in_specs=(spec_p, P()),
+            out_specs=spec_p,
+            check_vma=False,
+        )
+    )
+
+
+def probe_plan(
+    cfg: SolverConfig,
+    width: int = 1,
+    iters: Optional[int] = None,
+    warmup: int = 1,
+    emit: bool = True,
+) -> List[Dict[str, Any]]:
+    """Time every link of ``cfg``'s effective exchange plan; return (and
+    by default ledger-emit) one ``comm_probe`` row per link.
+
+    Row fields: link identity (``axis``/``axis_name``/``direction``/
+    ``sub_block``/``scope``), plan provenance (``plan_key``,
+    ``plan_mode``, ``width``, ``mesh``), the plan-predicted message bytes
+    (``bytes_predicted``), the measured per-collective p50 (``t_s``) and
+    the ratio (``gbps``), plus the bench-grade timing provenance
+    (``iters``, ``trips``, ``sync_rtt_s``, ``rtt_dominated``,
+    ``platform``). Empty list on a (1,1,1) mesh — no link exists.
+    """
+    it = probe_iters() if iters is None else max(1, int(iters))
+    eff = effective_halo_plan(cfg)
+    plan = plan_for(dataclasses.replace(cfg, halo_plan=eff), width)
+    itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    links = probe_links(plan, cfg.local_shape, itemsize)
+    if not links:
+        return []
+    mesh = build_mesh(cfg.mesh)
+    sharding = NamedSharding(mesh, P(*cfg.mesh.axis_names))
+    rtt = sync_overhead(probe=jnp.zeros((8, 128)))
+    ledger = obs.get()
+    rows: List[Dict[str, Any]] = []
+    for link in links:
+        run_n = _link_program(
+            mesh, cfg.mesh.axis_names, link["axis_name"], link["perm"],
+            link["scope"],
+        )
+        gshape = tuple(
+            link["sub_shape"][d] * cfg.mesh.shape[d] for d in range(3)
+        )
+        f = jax.device_put(
+            jnp.zeros(gshape, jnp.dtype(cfg.precision.storage)), sharding
+        )
+        for _ in range(warmup):
+            force_sync(run_n(f, jnp.int32(1)))
+
+        def _timed(n, _run=run_n, _f=f):
+            t0 = time.perf_counter()
+            force_sync(_run(_f, jnp.int32(n)))
+            return time.perf_counter() - t0
+
+        trips, _ = calibrate_trip_count(_timed, rtt, start=25)
+        raws = [_timed(trips) for _ in range(it)]
+        times = [honest_time(t, rtt) / trips for t in raws]
+        t50 = percentile(times, 50)
+        row = {
+            "axis": link["axis"],
+            "axis_name": link["axis_name"],
+            "direction": link["direction"],
+            "sub_block": link["sub_block"],
+            "scope": link["scope"],
+            "width": plan.width,
+            "mesh": list(cfg.mesh.shape),
+            "plan_key": plan.key,
+            "plan_mode": plan.mode,
+            "bytes_predicted": link["bytes_predicted"],
+            "t_s": t50,
+            "gbps": link["bytes_predicted"] / t50 / 1e9 if t50 > 0 else None,
+            "iters": it,
+            "trips": trips,
+            "sync_rtt_s": rtt,
+            "rtt_dominated": min(raws) < 2 * rtt,
+            "platform": jax.default_backend(),
+        }
+        rows.append(row)
+        if emit:
+            ledger.event("comm_probe", **row)
+    return rows
+
+
+def maybe_probe(cfg: SolverConfig, width: int = 1) -> List[Dict[str, Any]]:
+    """The env-gated hook ``bench_halo`` calls after its row: runs the
+    probe iff ``HEAT3D_COMM_PROBE`` opts in, and fails SOFT — probe
+    telemetry must never kill the bench that hosts it."""
+    if not comm_probe_enabled():
+        return []
+    try:
+        return probe_plan(cfg, width=width)
+    except Exception as e:  # noqa: BLE001 - telemetry fails soft
+        print(
+            f"heat3d: comm probe failed ({type(e).__name__}: "
+            f"{str(e)[:120]}); run continues unprobed",
+            file=sys.stderr,
+        )
+        return []
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone probe CLI (``python -m heat3d_tpu.obs.comm.probe``) —
+    invoking it IS the opt-in, no env needed. Prints one JSON row per
+    link (``--json``) or a readable table; ledger events go to
+    ``--ledger`` / ``HEAT3D_LEDGER`` when configured."""
+    ap = argparse.ArgumentParser(
+        prog="heat3d-comm-probe",
+        description="time every (axis, direction, sub-block) halo link "
+        "of an exchange plan as its own micro-program",
+    )
+    ap.add_argument("--grid", type=int, nargs="+", default=[16],
+                    help="global grid (1 value = cube, or 3)")
+    ap.add_argument("--mesh", type=int, nargs="+", required=True,
+                    help="device mesh extents (1 value = slab, or 3)")
+    ap.add_argument("--width", type=int, default=1, help="ghost width")
+    ap.add_argument("--halo-plan", default="monolithic",
+                    choices=("monolithic", "partitioned", "auto"))
+    ap.add_argument("--halo-order", default="axis",
+                    choices=("axis", "pairwise"))
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--iters", type=int, default=None,
+                    help=f"timed samples per link (default {DEFAULT_ITERS})")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="one JSON row per link on stdout")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (HEAT3D_LEDGER fallback)")
+    args = ap.parse_args(argv)
+
+    grid = args.grid if len(args.grid) == 3 else [args.grid[0]] * 3
+    mesh = list(args.mesh) + [1] * (3 - len(args.mesh))
+    cfg = SolverConfig(
+        grid=GridConfig(shape=tuple(grid)),
+        mesh=MeshConfig(shape=tuple(mesh[:3])),
+        halo_plan=args.halo_plan,
+        halo_order=args.halo_order,
+    )
+    cfg = dataclasses.replace(
+        cfg, precision=dataclasses.replace(cfg.precision, storage=args.dtype)
+    )
+    obs.activate(args.ledger, meta={"entry": "comm_probe"})
+    try:
+        rows = probe_plan(cfg, width=args.width, iters=args.iters)
+        if args.as_json:
+            for row in rows:
+                print(json.dumps(row))
+        elif not rows:
+            print("comm probe: no links (single-device mesh)")
+        else:
+            for row in rows:
+                blk = (
+                    f".p{row['sub_block']}"
+                    if row["sub_block"] is not None
+                    else ""
+                )
+                flag = " (rtt-dominated)" if row["rtt_dominated"] else ""
+                print(
+                    f"{row['axis_name']}.{row['direction']}{blk}: "
+                    f"{row['t_s'] * 1e6:.1f}us for "
+                    f"{row['bytes_predicted']}B predicted -> "
+                    f"{row['gbps']:.3f} GB/s{flag}"
+                )
+        return 0
+    finally:
+        obs.deactivate(rc=0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,19 @@ bounds the skew-plus-real-stagger for that phase boundary; the reported
 ``skew_s`` per source is its offset from the earliest anchor. True clock
 skew and genuine start stagger are indistinguishable from ledgers alone —
 the stats say so rather than pretending otherwise.
+
+``--align`` (the comm-observatory leg, docs/OBSERVABILITY.md §9) goes
+one step further: it subtracts each source's anchor offset from every
+wall ``ts`` in that source's stream (the original stamp is preserved as
+``ts_raw``), so downstream consumers — ``obs timeline --align``, the
+cross-host straggler detectors — compare hosts on one estimated clock
+and a skewed host clock can no longer masquerade as a straggler. The
+correction is exactly as good as the anchor barrier: the recorded
+``clock_align`` stats carry a confidence interval (``ci_s``) bounding
+the alignment error by the worst residual once-per-source spread after
+alignment plus the worst host's measured sync RTT, and real start
+stagger widens it honestly. Monotonic ``t0``/``t1``/``dur_s`` are
+per-process and are never rewritten.
 """
 
 from __future__ import annotations
@@ -50,12 +63,15 @@ def read_events(path: str) -> List[Dict[str, Any]]:
 
 
 def merge_ledgers(
-    paths: List[str], anchor: Optional[str] = None
+    paths: List[str], anchor: Optional[str] = None, align: bool = False
 ) -> Dict[str, Any]:
     """Merge the ledgers at ``paths``. Returns ``{"events": [...],
     "stats": {...}}`` — events tagged with ``src`` and sorted by ``ts``
     (stable: per-stream order preserved), stats as described in the
-    module docstring."""
+    module docstring. ``align=True`` additionally rewrites each
+    source's wall timestamps onto the anchor-aligned clock (originals
+    kept as ``ts_raw``; ``stats["clock_align"]`` records the offsets
+    and the confidence interval)."""
     per_src: Dict[str, List[Dict[str, Any]]] = {}
     for p in paths:
         evs = read_events(p)
@@ -121,6 +137,27 @@ def merge_ledgers(
             ),
         }
 
+    # ``--align``: subtract each source's anchor offset from its wall
+    # stamps, preserving the original as ``ts_raw``. Requires a common
+    # anchor across >= 2 sources — with nothing to align against, the
+    # merge stays raw and says so instead of silently rewriting time.
+    aligned = False
+    if align and chosen is not None and base is not None and len(known) > 1:
+        for src, evs in per_src.items():
+            off = sources[src]["skew_s"]
+            sources[src]["align_offset_s"] = off
+            if off:
+                for e in evs:
+                    if isinstance(e.get("ts"), (int, float)):
+                        e["ts_raw"] = e["ts"]
+                        e["ts"] = float(e["ts"]) - off
+        merged.sort(
+            key=lambda e: (
+                e["ts"] if isinstance(e.get("ts"), (int, float)) else 0.0
+            )
+        )
+        aligned = True
+
     stats = {
         "sources": sources,
         "anchor_event": chosen,
@@ -157,6 +194,61 @@ def merge_ledgers(
     stats["anchor_spreads_s"] = dict(
         sorted(spreads.items(), key=lambda kv: kv[1])
     )
+
+    if aligned:
+        # the chosen anchor's aligned spread is 0 by construction; the
+        # worst REMAINING once-per-source spread bounds how well one
+        # offset per host explained the rest of the run (residual skew
+        # drift + real stagger), and each host's own timestamp jitter is
+        # bounded by its measured sync RTT where one was recorded
+        residual = max(
+            (v for k, v in spreads.items() if k != chosen), default=0.0
+        )
+        rtts: Dict[str, Optional[float]] = {}
+        for src, evs in per_src.items():
+            vals = [
+                float(e["sync_rtt_s"])
+                for e in evs
+                if isinstance(e.get("sync_rtt_s"), (int, float))
+            ]
+            rtts[src] = round(max(vals), 6) if vals else None
+        ci = round(
+            residual
+            + max((v for v in rtts.values() if v is not None), default=0.0),
+            6,
+        )
+        stats["clock_align"] = {
+            "applied": True,
+            "anchor_event": chosen,
+            "offsets_s": {s: sources[s]["skew_s"] for s in per_src},
+            "sync_rtt_s": rtts,
+            "residual_spread_s": residual,
+            "ci_s": ci,
+            "note": (
+                "offsets are each source's anchor skew; ci_s bounds the "
+                "alignment error by the worst residual once-per-source "
+                "spread plus the worst measured sync RTT — real stagger "
+                "widens it honestly"
+            ),
+        }
+        from heat3d_tpu import obs
+
+        obs.get().event(
+            "clock_align",
+            anchor_event=chosen,
+            sources=len(per_src),
+            max_offset_s=round(max(known) - min(known), 6),
+            ci_s=ci,
+        )
+    elif align:
+        stats["clock_align"] = {
+            "applied": False,
+            "anchor_event": chosen,
+            "note": (
+                "alignment needs an anchor event present in every "
+                "source and >= 2 sources; merge left on raw clocks"
+            ),
+        }
     return {"events": merged, "stats": stats}
 
 
@@ -177,11 +269,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="event name to anchor skew on (default: first of "
         f"{'/'.join(ANCHOR_PREFERENCE)} present in every ledger)",
     )
+    ap.add_argument(
+        "--align", action="store_true",
+        help="rewrite each source's wall timestamps onto the "
+        "anchor-aligned clock (originals kept as ts_raw; the recorded "
+        "clock_align stats carry the confidence interval)",
+    )
     ap.add_argument("--json", action="store_true",
                     help="print stats as one JSON object")
     args = ap.parse_args(argv)
 
-    result = merge_ledgers(args.ledgers, anchor=args.anchor)
+    result = merge_ledgers(args.ledgers, anchor=args.anchor, align=args.align)
     if args.out:
         with open(args.out, "w") as f:
             for e in result["events"]:
@@ -214,6 +312,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             "  spread per once-per-source event (skew + stagger): "
             + ", ".join(f"{n}={v}s" for n, v in worst)
         )
+    ca = stats.get("clock_align")
+    if ca is not None:
+        if ca["applied"]:
+            print(
+                f"  aligned on {ca['anchor_event']}: "
+                f"ci ±{ca['ci_s']}s (residual {ca['residual_spread_s']}s); "
+                "originals kept as ts_raw"
+            )
+        else:
+            print(f"  NOT aligned: {ca['note']}")
     return 0
 
 

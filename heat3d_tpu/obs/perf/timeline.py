@@ -217,8 +217,12 @@ def summarize_trace_main(argv: Optional[List[str]] = None) -> int:
 
 def normalize_phase(token: str) -> str:
     """Canonical phase key for a ``heat3d.*`` scope token: the prefix is
-    stripped and the per-axis halo sub-scopes (``halo.x``/``halo.y``/...)
-    fold into ``halo_exchange`` — the names then join
+    stripped and the halo sub-scopes — per-axis (``halo.x``), the comm
+    observatory's per-direction (``halo.x.lo``), per-sub-block
+    (``halo.x.lo.p0``) and per-axis DMA (``halo.x.dma``) scopes — all
+    fold into ``halo_exchange``, so the roofline/timeline joins keep
+    attributing the finer-grained exchange scopes to the one exchange
+    phase instead of ``(unattributed)``. The names then join
     ``parallel.step.phase_programs`` / the ledger spans on one key."""
     if token.startswith("heat3d."):
         token = token[len("heat3d."):]
@@ -518,7 +522,22 @@ def detect_anomalies(
       ``obs merge``'d pod ledger, or multi-proc), each host's per-step
       p50 is judged against the fleet p50. Sequential runs in a
       single-host ledger are ONE identity — never compared against each
-      other.
+      other. DURATION-based, so it is immune to wall-clock skew.
+    - **Late starter** (``kind_: start_straggler``): cross-host
+      comparison of step-span WALL STARTS (``ts - dur_s``), matched by
+      per-host sample index and judged as a fraction of the fleet's
+      step-span p50. This one READS WALL CLOCKS, so it is exactly as
+      trustworthy as the clocks are aligned: on raw merged ledgers a
+      skewed host clock masquerades as a late starter, and ``obs merge
+      --align`` / ``obs timeline --align`` is the documented cure (the
+      tests pin both directions). Only LATE hosts flag — a fast clock
+      reads as early, which is not a straggler.
+    - **Slow link** (``kind_: link_straggler``): per-(axis, direction)
+      ``comm_probe`` samples (the ``HEAT3D_COMM_PROBE`` probe — sub-block
+      rows fold into their parent link) compared across hosts: each
+      host's per-link p50 is judged against the fleet p50 for the SAME
+      link, naming the slow link rather than just the slow host.
+      DURATION-based like host_straggler, so skew-immune.
 
     All percentiles use ``obs.metrics.percentile`` (nearest-rank) — the
     one rule every obs reconstruction shares. Returns records ready to
@@ -595,6 +614,95 @@ def detect_anomalies(
                             "status": status,
                         }
                     )
+    # late starter: cross-host comparison of step-span WALL STARTS
+    # (ts - dur_s), index-matched so step i is compared against the
+    # fleet's step i. Judged as a fraction of the fleet step-span p50;
+    # wall-clock-based by construction (see docstring) — feed it aligned
+    # time (obs merge --align) on multihost ledgers.
+    start_streams: Dict[Tuple[str, Any], List[float]] = defaultdict(list)
+    span_durs: List[float] = []
+    for r in events:
+        if r.get("kind") != "span" or r.get("status") != "ok":
+            continue
+        if str(r.get("event")) not in STEP_SPANS:
+            continue
+        ts, dur = r.get("ts"), r.get("dur_s")
+        if isinstance(ts, (int, float)) and isinstance(dur, (int, float)):
+            start_streams[(str(r.get("src", "")), r.get("proc", 0))].append(
+                float(ts) - float(dur)
+            )
+            span_durs.append(float(dur))
+    if len(start_streams) > 1 and span_durs:
+        fleet_dur = percentile(span_durs, 50)
+        n = min(len(v) for v in start_streams.values())
+        if fleet_dur > 0 and n > 0:
+            hosts = sorted(start_streams)
+            med = [
+                percentile([start_streams[h][i] for h in hosts], 50)
+                for i in range(n)
+            ]
+            for h in hosts:
+                offs = [start_streams[h][i] - med[i] for i in range(n)]
+                off = percentile(offs, 50)
+                delta = off / fleet_dur * 100.0
+                status = band_status(delta, warn_pct, fail_pct)
+                if status != "pass":
+                    anomalies.append(
+                        {
+                            "kind_": "start_straggler",
+                            "src": h[0],
+                            "proc": h[1],
+                            "offset_s": round(off, 9),
+                            "fleet_span_p50_s": round(fleet_dur, 9),
+                            "delta_pct": round(delta, 2),
+                            "status": status,
+                        }
+                    )
+
+    # slow link: per-(axis, direction) comm_probe samples compared
+    # across hosts — the link, not just the host, gets named. Sub-block
+    # rows fold into their parent link (one attribution unit).
+    by_link: Dict[
+        Tuple[str, str], Dict[Tuple[str, Any], List[float]]
+    ] = defaultdict(lambda: defaultdict(list))
+    for r in events:
+        if r.get("event") != "comm_probe":
+            continue
+        t, ax, dr = r.get("t_s"), r.get("axis_name"), r.get("direction")
+        if (
+            isinstance(t, (int, float))
+            and t > 0
+            and isinstance(ax, str)
+            and dr in ("lo", "hi")
+        ):
+            by_link[(ax, str(dr))][
+                (str(r.get("src", "")), r.get("proc", 0))
+            ].append(float(t))
+    for (ax, dr), hosts_d in sorted(by_link.items()):
+        if len(hosts_d) < 2:
+            continue  # a link seen by one host has no fleet to lag
+        p50s = {h: percentile(v, 50) for h, v in sorted(hosts_d.items())}
+        fleet = percentile(list(p50s.values()), 50)
+        if fleet <= 0:
+            continue
+        for (src, proc), p50 in p50s.items():
+            delta = (p50 - fleet) / fleet * 100.0
+            status = band_status(delta, warn_pct, fail_pct)
+            if status != "pass":
+                anomalies.append(
+                    {
+                        "kind_": "link_straggler",
+                        "src": src,
+                        "proc": proc,
+                        "axis": ax,
+                        "direction": dr,
+                        "p50_s": round(p50, 9),
+                        "fleet_p50_s": round(fleet, 9),
+                        "delta_pct": round(delta, 2),
+                        "status": status,
+                    }
+                )
+
     anomalies.sort(key=lambda a: (a["status"] != "fail", -a["delta_pct"]))
     return anomalies
 
@@ -606,6 +714,19 @@ def format_anomaly(a: Dict[str, Any]) -> str:
         return (
             f"{tag} straggler {who}: step p50 {a['p50_s'] * 1e3:.3f}ms vs "
             f"fleet {a['fleet_p50_s'] * 1e3:.3f}ms ({a['delta_pct']:+.1f}%)"
+        )
+    if a.get("kind_") == "start_straggler":
+        return (
+            f"{tag} late starter {who}: steps begin "
+            f"{a['offset_s'] * 1e3:+.3f}ms vs fleet "
+            f"({a['delta_pct']:+.1f}% of a step span; wall-clock-based — "
+            "align merged ledgers first)"
+        )
+    if a.get("kind_") == "link_straggler":
+        return (
+            f"{tag} slow link {a.get('axis')}.{a.get('direction')} {who}: "
+            f"p50 {a['p50_s'] * 1e6:.1f}us vs fleet "
+            f"{a['fleet_p50_s'] * 1e6:.1f}us ({a['delta_pct']:+.1f}%)"
         )
     unit = "/step" if a.get("per_step") else ""
     return (
@@ -628,17 +749,22 @@ def emit_anomalies(anomalies: List[Dict[str, Any]]) -> None:
 # ---- CLI -------------------------------------------------------------------
 
 
-def _read_streams(paths: List[str]) -> List[Dict[str, Any]]:
+def _read_streams(
+    paths: List[str], align: bool = False
+) -> List[Dict[str, Any]]:
     """One ledger reads directly; several merge through
     ``obs.perf.merge.merge_ledgers`` so each keeps its ``src`` tag (the
-    straggler detector and the per-stream tracks key on it)."""
+    straggler detector and the per-stream tracks key on it).
+    ``align=True`` merges onto the anchor-aligned clock (obs merge
+    --align) so the wall-clock-based detectors judge estimated true
+    time; it is meaningless (and ignored) for a single ledger."""
     if len(paths) == 1:
         from heat3d_tpu.obs.cli import read_ledger
 
         return read_ledger(paths[0])
     from heat3d_tpu.obs.perf.merge import merge_ledgers
 
-    return merge_ledgers(paths)["events"]
+    return merge_ledgers(paths, align=align)["events"]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -658,6 +784,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="profile capture dir (or .xplane.pb): adds the "
                     "per-phase device-time aggregate track and the phase "
                     "table")
+    ap.add_argument("--align", action="store_true",
+                    help="merge multiple ledgers onto the anchor-aligned "
+                    "clock (obs merge --align) before detection, so a "
+                    "skewed host clock cannot masquerade as a late "
+                    "starter")
     ap.add_argument("--anomalies", action="store_true",
                     help="also emit obs_anomaly ledger events for every "
                     "detected drift/straggler (detection itself always "
@@ -672,7 +803,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        events = _read_streams(args.ledgers)
+        events = _read_streams(args.ledgers, align=args.align)
     except OSError as e:
         print(f"timeline: cannot read ledger: {e}", file=sys.stderr)
         return 2

@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
+from heat3d_tpu.obs.trace import named_phase
 from heat3d_tpu.utils.compat import pallas_tpu_compiler_params
 
 
@@ -250,13 +251,40 @@ def exchange_axis_dma(
             ghost_hi = jnp.full_like(hi_face, bc_value)
         return lax.concatenate([ghost_lo, u, ghost_hi], dimension=axis)
 
+    # per-axis comm scope (halo.<axis>.dma): both directions are fused
+    # inside one DMA kernel here, so the axis is the finest HONEST
+    # attribution unit on this transport — unlike the ppermute path's
+    # per-direction scopes (normalize_phase folds both spellings into
+    # halo_exchange for the coarse joins)
     if width == 1:
-        # zero-staging fast path: faces DMA'd straight out of u
-        return _exchange_axis_dma_width1(
+        with named_phase(f"halo.{axis_name}.dma"):
+            # zero-staging fast path: faces DMA'd straight out of u
+            return _exchange_axis_dma_width1(
+                u, axis, axis_name, axis_size, mesh_axes, periodic,
+                bc_value, interpret,
+            )
+
+    with named_phase(f"halo.{axis_name}.dma"):
+        return _exchange_axis_dma_slab(
             u, axis, axis_name, axis_size, mesh_axes, periodic, bc_value,
-            interpret,
+            width, interpret,
         )
 
+
+def _exchange_axis_dma_slab(
+    u: jax.Array,
+    axis: int,
+    axis_name: str,
+    axis_size: int,
+    mesh_axes,
+    periodic: bool,
+    bc_value: float,
+    width: int,
+    interpret: bool,
+) -> jax.Array:
+    """Width-k slab exchange body (split out of ``exchange_axis_dma`` so
+    the per-axis comm scope wraps it cleanly)."""
+    n = u.shape[axis]
     lo_face = _to_axis_leading(lax.slice_in_dim(u, 0, width, axis=axis), axis)
     hi_face = _to_axis_leading(
         lax.slice_in_dim(u, n - width, n, axis=axis), axis

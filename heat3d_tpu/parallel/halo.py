@@ -118,10 +118,17 @@ def axis_ghosts(
         perm_down = shift_perm(axis_size, -1, periodic)
     else:
         perm_up, perm_down = perms
-    # my low ghost = low neighbor's high face: shift high faces "up" (+1)
-    ghost_lo = lax.ppermute(hi_face, axis_name, perm_up)
-    # my high ghost = high neighbor's low face: shift low faces "down" (-1)
-    ghost_hi = lax.ppermute(lo_face, axis_name, perm_down)
+    # per-direction scopes nested under halo.<axis>: each ppermute's
+    # device time attributes to the LINK that carried it ("lo" = the
+    # transfer filling my low ghost — the same link key the comm-probe
+    # rows and the link_straggler detector use; normalize_phase folds
+    # halo.* back into halo_exchange for the coarse joins)
+    with named_phase(f"halo.{axis_name}.lo"):
+        # my low ghost = low neighbor's high face: shift high faces "up" (+1)
+        ghost_lo = lax.ppermute(hi_face, axis_name, perm_up)
+    with named_phase(f"halo.{axis_name}.hi"):
+        # my high ghost = high neighbor's low face: shift low faces "down" (-1)
+        ghost_hi = lax.ppermute(lo_face, axis_name, perm_down)
     return substitute_domain_bc(
         ghost_lo, ghost_hi, axis_name, axis_size, periodic, bc_value
     )

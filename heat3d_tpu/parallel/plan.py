@@ -55,6 +55,7 @@ import os
 from typing import Any, Dict, Optional, Tuple
 
 from heat3d_tpu.core.config import BoundaryCondition, MeshConfig, SolverConfig
+from heat3d_tpu.obs.trace import named_phase
 from heat3d_tpu.parallel.halo import (
     axis_ghosts,
     exchange_halo,
@@ -229,12 +230,25 @@ class ExchangePlan:
             self._face_partitions(lo_face.shape, lo_face.dtype.itemsize),
         )
         glo_parts, ghi_parts = [], []
-        for a, b in bounds:
+        for i, (a, b) in enumerate(bounds):
             lo_p = lax.slice_in_dim(lo_face, a, b, axis=pd)
             hi_p = lax.slice_in_dim(hi_face, a, b, axis=pd)
-            # my low ghost = low neighbor's high face (shift up), per block
-            glo_parts.append(lax.ppermute(hi_p, axis_name, spec.perm_up))
-            ghi_parts.append(lax.ppermute(lo_p, axis_name, spec.perm_down))
+            # per-sub-block scopes (halo.<axis>.<dir>.p<i>, degenerate
+            # single-block schedules keep the plain per-direction name):
+            # each early-bird send's device time attributes to ITS
+            # sub-block — the granularity the partitioned-MPI trade
+            # actually lives at (normalize_phase folds all of them back
+            # into halo_exchange for the coarse joins)
+            blk = f".p{i}" if len(bounds) > 1 else ""
+            with named_phase(f"halo.{axis_name}.lo{blk}"):
+                # my low ghost = low neighbor's high face (shift up)
+                glo_parts.append(
+                    lax.ppermute(hi_p, axis_name, spec.perm_up)
+                )
+            with named_phase(f"halo.{axis_name}.hi{blk}"):
+                ghi_parts.append(
+                    lax.ppermute(lo_p, axis_name, spec.perm_down)
+                )
         if len(bounds) == 1:
             ghost_lo, ghost_hi = glo_parts[0], ghi_parts[0]
         else:

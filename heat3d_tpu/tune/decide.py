@@ -108,14 +108,32 @@ def pair_rows(entries):
             yield k, fixed, (kb[k], rb), (ka[k], ra)
 
 
-def decide(entries, min_win_pct: float = DEFAULT_MIN_WIN_PCT):
-    """Return decision dicts for every single-knob A/B pair found."""
+def decide(
+    entries,
+    min_win_pct: float = DEFAULT_MIN_WIN_PCT,
+    metric=None,
+    prefer: str = "higher",
+):
+    """Return decision dicts for every single-knob A/B pair found.
+
+    ``metric`` overrides the throughput-key lookup with any
+    ``row -> float | None`` extractor, and ``prefer='lower'`` flips the
+    winner rule for cost-like metrics (latency p50s — ``obs adjudicate``
+    judges the halo A/Bs this way). Defaults reproduce the historical
+    behavior exactly: throughput keys, higher wins. The speedup margin
+    is winner-relative-to-loser either way, so it stays symmetric.
+    """
+    metric_fn = _metric if metric is None else metric
+    lower_wins = prefer == "lower"
     out = []
     for knob, fixed, (va, ra), (vb, rb) in pair_rows(entries):
-        ga, gb = _metric(ra), _metric(rb)
-        if ga <= 0 or gb <= 0:
+        ga, gb = metric_fn(ra), metric_fn(rb)
+        if ga is None or gb is None or ga <= 0 or gb <= 0:
             continue
-        winner = vb if gb >= ga else va
+        if lower_wins:
+            winner = vb if gb <= ga else va
+        else:
+            winner = vb if gb >= ga else va
         # winner relative to LOSER, symmetric in orientation: the same gap
         # must yield the same margin whichever side the lower knob value is
         margin = (max(ga, gb) / min(ga, gb) - 1.0) * 100.0

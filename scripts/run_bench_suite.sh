@@ -613,3 +613,54 @@ PYEOF
 else
   note "suite: monitor smoke skipped (SKIP_MONITOR_SMOKE=1)"
 fi
+
+# Comm-probe smoke (informational; docs/OBSERVABILITY.md §9): the
+# per-link halo probe on a forced 4-device CPU mesh — both x-axis links
+# (lo, hi) must land comm_probe ledger events carrying plan-predicted
+# bytes joined to a positive measured time, machine-checked with the
+# JSON verdict on the console. Always CPU (the path under test is the
+# probe plumbing, not the interconnect). Sub-minute. Fails SOFT;
+# SKIP_COMM_SMOKE=1 skips.
+if [[ -z "${SKIP_COMM_SMOKE:-}" ]]; then
+  COMM_LED="${OUT%.jsonl}.comm.ledger.jsonl"
+  : > "$COMM_LED"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    HEAT3D_COMM_PROBE_ITERS=3 \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
+    python -m heat3d_tpu.obs.comm.probe --grid 16 --mesh 4 1 1 \
+    --json --ledger "$COMM_LED" >> "$SUITE_LOG" 2>&1 \
+    || note "suite: comm probe smoke failed (rc=$?) — informational"
+  python - "$COMM_LED" <<'PYEOF' \
+    || note "suite: comm probe verdict failed — informational"
+import json, sys
+rows = []
+try:
+    with open(sys.argv[1]) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if e.get("event") == "comm_probe":
+                    rows.append(e)
+except OSError:
+    pass
+links = sorted({(e.get("axis_name"), e.get("direction")) for e in rows})
+ok = (
+    links == [("x", "hi"), ("x", "lo")]
+    and all(e.get("bytes_predicted", 0) > 0 for e in rows)
+    and all(e.get("t_s", 0) > 0 for e in rows)
+)
+print(json.dumps({"comm_smoke": {
+    "ok": ok, "rows": len(rows),
+    "links": [".".join(l) for l in links],
+    "gbps": [round(e.get("gbps", 0), 4) for e in rows],
+}}))
+sys.exit(0 if ok else 1)
+PYEOF
+else
+  note "suite: comm probe smoke skipped (SKIP_COMM_SMOKE=1)"
+fi
