@@ -205,7 +205,13 @@ def _check_exchange_groups(case, sites, out: List[Finding]):
                             "unmatched transfer",
                         )
                     )
-                elif len(s0) != 1 and not partitioned:
+                elif (
+                    len(s0) not in (1, getattr(case, "carry_levels", 1))
+                    and not partitioned
+                ):
+                    # a multi-level carry (leapfrog) legitimately ships
+                    # one permute pair PER EXCHANGED LEVEL; anything
+                    # else on a monolithic plan is sub-block drift
                     out.append(
                         _finding(
                             case,
@@ -215,9 +221,9 @@ def _check_exchange_groups(case, sites, out: List[Finding]):
                             f"carries {n} ppermutes over axis {a!r} on a "
                             "MONOLITHIC plan; a width-k exchange is "
                             "exactly one low-face and one high-face "
-                            "permute per superstep call (sub-block "
-                            "multiplicity is the partitioned plan's "
-                            "contract)",
+                            "permute per superstep call per carry level "
+                            "(sub-block multiplicity is the partitioned "
+                            "plan's contract)",
                         )
                     )
             elif len(classes) == 1:
@@ -238,7 +244,10 @@ def _check_exchange_groups(case, sites, out: List[Finding]):
                             "gets its return leg",
                         )
                     )
-                elif n != 2 and not partitioned:
+                elif (
+                    n not in (2, 2 * getattr(case, "carry_levels", 1))
+                    and not partitioned
+                ):
                     out.append(
                         _finding(
                             case,
@@ -246,7 +255,8 @@ def _check_exchange_groups(case, sites, out: List[Finding]):
                             f"pair-count:{a}:loop{len(path)}",
                             f"exchange group (loop depth {len(path)}) "
                             f"carries {n} ppermutes over axis {a!r} on a "
-                            "MONOLITHIC plan; expected exactly 2",
+                            "MONOLITHIC plan; expected exactly 2 per "
+                            "carry level",
                         )
                     )
             else:
@@ -290,10 +300,19 @@ def _check_halo_order(case, sites, out: List[Finding]):
         dims = _spatial_dims(case, s.in_shapes[0])
         if len(dims) != 3:
             continue
+        # a multi-level carry exchanges each level at ITS OWN width
+        # (leapfrog: k and k-1) — sub-group by width so each level's
+        # face-extent contract is judged on its own terms; single-level
+        # cases keep the strict one-width-per-face grouping
+        width_leg = (
+            dims[axis_pos[axis]]
+            if getattr(case, "carry_levels", 1) > 1
+            else None
+        )
         groups.setdefault(
-            (s.loop_path, axis, frozenset(s.perm or ())), []
+            (s.loop_path, axis, frozenset(s.perm or ()), width_leg), []
         ).append(dims)
-    for (_, axis, perm), dim_list in groups.items():
+    for (_, axis, perm, _w), dim_list in groups.items():
         self_inverse = frozenset((d, s) for s, d in perm) == perm
         i = axis_pos[axis]
         w = dim_list[0][i]

@@ -122,6 +122,34 @@ def check_case(case) -> List[Finding]:
     measured = _measured_widths(case, sites)
     group_k = _group_ks(case, sorted({p for p, _, _ in measured}))
     ks = sorted(set(group_k.values()) or {case.k})
+    if getattr(case, "carry_levels", 1) > 1 and case.k > 1:
+        # two-level carry superstep (leapfrog): per (group, axis) the
+        # exchanged widths must be EXACTLY the ring plan's pair —
+        # level 0 ships k*r (it is applied k times), level 1 ships
+        # (k-1)*r (it only backs the k-1 ring recomputes). A lone
+        # width, or any other pair, under- or over-ships ghosts.
+        by: dict = {}
+        for path, axis, w in measured:
+            by.setdefault((path, axis), []).append(w)
+        for (path, axis), ws in sorted(by.items()):
+            ri = r[axis_pos[axis]]
+            want = sorted({case.k * ri, (case.k - 1) * ri})
+            if sorted(set(ws)) != want:
+                out.append(
+                    _finding(
+                        case,
+                        "ANL701",
+                        ERROR,
+                        f"ghost-width:{axis}",
+                        f"two-level carry exchange over {axis!r} ships "
+                        f"ghost widths {sorted(set(ws))}, contract is "
+                        f"{want} (level 0 k*r for its k applications, "
+                        f"level 1 (k-1)*r for the ring recomputes): "
+                        "boundary cells consume ghosts that were never "
+                        "exchanged, or dead planes ship",
+                    )
+                )
+        measured = []
     for path, axis, w in measured:
         kk = group_k[path]
         need = kk * r[axis_pos[axis]]

@@ -74,6 +74,13 @@ class ProgramCase:
     spatial_axes: Tuple[str, ...] = ("x", "y", "z")
     batch_axes: Tuple[str, ...] = ()
     mesh_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # how many independent array exchanges one dynamic exchange group
+    # legitimately carries: the leapfrog two-level carry (levels at
+    # widths k*r and (k-1)*r — footprint checks that exact pair), the
+    # CG solve's constant-build + initial-matvec pair, the varcoef
+    # solution + coefficient-field pair. The collective checkers widen
+    # the one-permute-pair-per-face expectation by this factor.
+    carry_levels: int = 1
     _jaxpr: Any = None
 
     @property
@@ -105,6 +112,9 @@ def _case_key(cfg, kind: str) -> str:
         # minted before the eqn subsystem stays stable — the halo_plan
         # rule below, same reason
         bits.insert(0, cfg.equation)
+    if cfg.integrator != "explicit-euler":
+        # integrator leg only when non-default, same stability rule
+        bits.insert(0, cfg.integrator)
     bits += [
         f"g{cfg.grid.shape[0]}",
         f"m{mesh}",
@@ -231,6 +241,38 @@ def _ensemble_cases(num_devices: int) -> List[ProgramCase]:
                     mesh_sizes=mesh_sizes,
                 )
             )
+    # the variable-coefficient traced bind (PR 19): per-member FIELD
+    # arrays ride as a fourth runtime input sharded like the solution —
+    # its exchange topology (two ghost rides per update through one
+    # plan) certifies beside the constant-coefficient programs
+    vc_base = SolverConfig(
+        grid=GridConfig.cube(_GRID),
+        mesh=MeshConfig(shape=(2, 2, 1)),
+        backend="jnp",
+    )
+    vc_members = [
+        Scenario(coef_field=("checker", 0, 0.5, 1.5), steps=5),
+        Scenario(coef_field=("lognormal", 3, 0.4, 1.8), steps=7,
+                 bc_value=1.0),
+    ]
+    es = EnsembleSolver(ScenarioBatch(vc_base, vc_members), batch_mesh=1)
+    mesh_sizes = {BATCH_AXIS: 1}
+    mesh_sizes.update(zip(vc_base.mesh.axis_names, (2, 2, 1)))
+    for name, fn, args in es.ir_programs():
+        cases.append(
+            ProgramCase(
+                key=f"ensemble/coef-field/b1xm2x2x1/{name}",
+                cfg=es.cfg,
+                kind=f"ensemble_{name}",
+                path="heat3d_tpu/serve/ensemble.py",
+                fn=fn,
+                avals=tuple(args),
+                spatial_axes=es.cfg.mesh.axis_names,
+                batch_axes=(BATCH_AXIS,),
+                mesh_sizes=mesh_sizes,
+                carry_levels=2,  # solution + field per update
+            )
+        )
     return cases
 
 
@@ -308,6 +350,11 @@ def judged_matrix(num_devices: Optional[int] = None) -> List[ProgramCase]:
     for fam_name in sorted(FAMILIES):
         if fam_name == "heat":
             continue
+        if fam_name == "wave":
+            # wave's update is the leapfrog two-level carry, not the
+            # explicit sweep (the config layer couples them) — its
+            # programs certify in _timeint_cases below
+            continue
         fam = FAMILIES[fam_name]
         cases += _solver_cases(
             SolverConfig(
@@ -334,7 +381,98 @@ def judged_matrix(num_devices: Optional[int] = None) -> List[ProgramCase]:
             },
             compile_keys,
         )
+    cases += _timeint_cases(n)
     cases += _ensemble_cases(n)
+    return cases
+
+
+def _timeint_cases(num_devices: int) -> List[ProgramCase]:
+    """The time-integrator program families (PR 19): the wave leapfrog
+    two-level carry (step, superstep, residual), the implicit-CG
+    keep-masked solve, and the variable-coefficient flux step — traced
+    over the widest judged mesh. Kinds are integrator-prefixed ON
+    PURPOSE: the exact ``step``/``superstep`` round-trip budget (ANL803)
+    is an explicit-sweep contract (leapfrog legitimately up-converts two
+    carry levels per application), while the generic collective /
+    replication / alien-dtype invariants judge every kind — and the
+    ``*_residual`` kinds keep the full residual-psum contract
+    (ANL607/ANL802)."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat3d_tpu.core.config import GridConfig, MeshConfig, SolverConfig
+    from heat3d_tpu.parallel.topology import build_mesh
+    from heat3d_tpu.timeint import cg as ti_cg
+    from heat3d_tpu.timeint import coeffield, leapfrog
+
+    if num_devices >= 4:
+        mesh_shape = (2, 2, 1)
+    elif num_devices >= 2:
+        mesh_shape = (2, 1, 1)
+    else:
+        mesh_shape = (1, 1, 1)
+    mesh_cfg = MeshConfig(shape=mesh_shape)
+    cases: List[ProgramCase] = []
+
+    def add(cfg, kind, path, fn, avals, levels=1):
+        cases.append(
+            ProgramCase(
+                key=_case_key(cfg, kind),
+                cfg=cfg,
+                kind=kind,
+                path=path,
+                fn=fn,
+                avals=avals,
+                spatial_axes=cfg.mesh.axis_names,
+                mesh_sizes=dict(zip(cfg.mesh.axis_names, cfg.mesh.shape)),
+                carry_levels=levels,
+            )
+        )
+
+    wave = SolverConfig(
+        grid=GridConfig.cube(_GRID),
+        mesh=mesh_cfg,
+        backend="jnp",
+        equation="wave",
+        integrator="leapfrog",
+    )
+    mesh = build_mesh(wave.mesh)
+    aval = jax.ShapeDtypeStruct(
+        wave.padded_shape, jnp.dtype(wave.precision.storage)
+    )
+    carry = (aval, aval)
+    lf_path = "heat3d_tpu/timeint/leapfrog.py"
+    add(wave, "leapfrog_step", lf_path,
+        leapfrog.make_step_fn(wave, mesh), (carry,), levels=2)
+    add(wave, "leapfrog_residual", lf_path,
+        leapfrog.make_step_fn(wave, mesh, with_residual=True), (carry,),
+        levels=2)
+    wave2 = dataclasses.replace(wave, time_blocking=2)
+    add(wave2, "leapfrog_superstep", lf_path,
+        leapfrog.make_superstep_fn(wave2, mesh), (carry,), levels=2)
+
+    cgc = SolverConfig(
+        grid=GridConfig.cube(_GRID),
+        mesh=mesh_cfg,
+        backend="jnp",
+        integrator="implicit-cg",
+    )
+    cg_path = "heat3d_tpu/timeint/cg.py"
+    # CG's top level runs TWO exchanges (the zero-field boundary-inflow
+    # build and the initial-residual matvec) in one group; the fori body
+    # group has its own single matvec exchange
+    add(cgc, "cg_step", cg_path, ti_cg.make_step_fn(cgc, mesh), (aval,),
+        levels=2)
+    add(cgc, "cg_residual", cg_path,
+        ti_cg.make_step_fn(cgc, mesh, with_residual=True), (aval,),
+        levels=2)
+
+    vc = SolverConfig(
+        grid=GridConfig.cube(_GRID), mesh=mesh_cfg, backend="jnp"
+    )
+    # solution + coefficient field both ride the plan each update
+    add(vc, "coef_step", "heat3d_tpu/timeint/coeffield.py",
+        coeffield.make_varcoef_step_fn(vc, mesh), (aval, aval), levels=2)
     return cases
 
 

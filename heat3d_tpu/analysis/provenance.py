@@ -63,6 +63,15 @@ def check_row(r: dict) -> list:
                 "obs regress keys baselines on it; legacy rows key to "
                 "heat)"
             )
+        # integrator provenance (PR 19): integrators share grids but not
+        # per-step work (CG matvecs, two-level carries) — a rate must be
+        # keyable to its integrator from the row alone
+        if not (isinstance(r.get("integrator"), str) and r["integrator"]):
+            problems.append(
+                "integrator missing/empty (time-integrator provenance — "
+                "obs regress keys baselines on it; legacy rows key to "
+                "explicit-euler)"
+            )
         if "chain_ops" not in r:
             problems.append("missing route-provenance field 'chain_ops'")
         elif r["chain_ops"] is None and r.get("backend") != "conv":

@@ -54,6 +54,10 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
               "desc": "one supervised checkpoint window (force-synced)"},
     "init_state": {"kind": "span", "module": "models/heat3d.py",
                    "desc": "sharded initial-state construction"},
+    "cg_solve": {"kind": "point", "module": "models/heat3d.py",
+                 "desc": "implicit-cg run finished: steps, last solve's "
+                         "iteration count and relative residual (the "
+                         "stiff-dt convergence audit trail)"},
     # resilience
     "supervised_start": {"kind": "point", "module": "resilience/supervisor.py",
                          "desc": "supervisor engaged: target step, cadence"},
@@ -370,6 +374,14 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
                                        "(default 1800); in auto heal "
                                        "mode its expiry triggers the "
                                        "elastic fallback"},
+    "HEAT3D_CG_MAX_ITERS": {"module": "timeint/cg.py",
+                            "desc": "implicit-cg iteration cap per solve "
+                                    "(default 64; SPMD-uniform — every "
+                                    "device runs the masked loop to the "
+                                    "same bound)"},
+    "HEAT3D_CG_TOL": {"module": "timeint/cg.py",
+                      "desc": "implicit-cg relative-residual stop "
+                              "threshold (default 1e-6)"},
     "HEAT3D_TUNE_CACHE": {"module": "tune/cache.py",
                           "desc": "tuning-cache store path"},
     "HEAT3D_TUNE_DISABLE": {"module": "tune/cache.py",

@@ -152,6 +152,12 @@ def bench_throughput(
         # never baseline against — or masquerade as — a heat rate
         # (obs regress keys on it; legacy rows key to heat)
         "equation": cfg.equation,
+        # integrator provenance (REQUIRED by check_provenance.py on every
+        # throughput row): a CG solve's step does many matvecs and a
+        # leapfrog step carries two levels — their Gcell/s must never
+        # baseline against (or masquerade as) the explicit sweep (obs
+        # regress keys on it; legacy rows key to explicit-euler)
+        "integrator": cfg.integrator,
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "compute_dtype": cfg.precision.compute,

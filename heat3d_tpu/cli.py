@@ -70,6 +70,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="equation-family parameter override (repeatable), e.g. "
         "--eq-param vx=2.0; defaults per `heat3d eqn show FAMILY`",
     )
+    from heat3d_tpu.core.config import INTEGRATORS
+
+    p.add_argument(
+        "--integrator", choices=list(INTEGRATORS), default="explicit-euler",
+        help="time integrator (docs/INTEGRATORS.md): 'explicit-euler' "
+        "(default, the tuned explicit route), 'leapfrog' (the wave "
+        "family's two-level carry), 'implicit-cg' (matrix-free CG "
+        "backward Euler — unconditionally stable, dt may exceed the "
+        "explicit CFL bound; HEAT3D_CG_MAX_ITERS/HEAT3D_CG_TOL tune "
+        "the solve)",
+    )
     p.add_argument("--bc", choices=["dirichlet", "periodic"], default="dirichlet")
     p.add_argument("--bc-value", type=float, default=0.0)
     p.add_argument(
@@ -247,6 +258,7 @@ def config_from_args(args) -> SolverConfig:
         halo_plan=args.halo_plan,
         equation=getattr(args, "equation", "heat"),
         eq_params=_parse_eq_params(getattr(args, "eq_param", [])),
+        integrator=getattr(args, "integrator", "explicit-euler"),
     )
 
 
@@ -342,6 +354,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
         grid=list(cfg.grid.shape),
         stencil=cfg.stencil.kind,
         equation=cfg.equation,
+        integrator=cfg.integrator,
         mesh=list(cfg.mesh.shape),
         dtype=cfg.precision.storage,
         backend=cfg.backend,
@@ -699,6 +712,7 @@ def _finish(
         "grid": list(cfg.grid.shape),
         "stencil": cfg.stencil.kind,
         "equation": cfg.equation,
+        "integrator": cfg.integrator,
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "backend": cfg.backend,
@@ -719,6 +733,13 @@ def _finish(
         summary.update(extra_summary)
 
     if args.golden_check:
+        if cfg.integrator != "explicit-euler":
+            raise SystemExit(
+                f"--golden-check covers the explicit-Euler oracle only "
+                f"(integrator={cfg.integrator!r}); the per-integrator "
+                "accuracy gates live in tests/test_timeint.py "
+                "(docs/INTEGRATORS.md)"
+            )
         from heat3d_tpu.core import golden
 
         # steps_done counts from t=0 even on --resume: the golden model must

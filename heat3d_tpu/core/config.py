@@ -187,6 +187,14 @@ class RunConfig:
     profile_dir: Optional[str] = None  # jax.profiler trace output
 
 
+# The time-integrator registry names (heat3d_tpu.timeint mirrors this
+# tuple; docs/INTEGRATORS.md). A module constant rather than a lazy
+# import: config validation must not depend on the timeint package
+# importing cleanly.
+INTEGRATORS: Tuple[str, ...] = ("explicit-euler", "leapfrog", "implicit-cg")
+DEFAULT_INTEGRATOR = "explicit-euler"
+
+
 @dataclasses.dataclass(frozen=True)
 class SolverConfig:
     """Everything needed to build a solver — the full judged-config surface."""
@@ -257,6 +265,16 @@ class SolverConfig:
     # configs stay usable as dict keys. Unknown names fail validation;
     # unset names take the family defaults (heat3d eqn show FAMILY).
     eq_params: Tuple[Tuple[str, float], ...] = ()
+    # Time integrator (heat3d_tpu.timeint registry; docs/INTEGRATORS.md):
+    # 'explicit-euler' — the legacy single-level forward-Euler carry (the
+    # bit-identical default; every pre-timeint config reads unchanged);
+    # 'leapfrog' — two-level (u, u_prev) carry for the second-order-in-
+    # time wave family; 'implicit-cg' — backward Euler via a matrix-free
+    # conjugate-gradient solve (keep-masked, pmax-bounded SPMD-uniform
+    # loop), opening dt regimes the explicit CFL bound forbids.
+    # Integrator/family coupling (wave <-> leapfrog, CG needs a symmetric
+    # operator) is validated with the equation below.
+    integrator: str = DEFAULT_INTEGRATOR
 
     def __post_init__(self):
         if not isinstance(self.eq_params, tuple):
@@ -312,8 +330,14 @@ class SolverConfig:
                     "transport; the DMA exchange kernels implement "
                     "axis-ordered propagation"
                 )
+        if self.integrator not in INTEGRATORS:
+            raise ValueError(
+                f"unknown integrator {self.integrator!r} "
+                f"(want {'|'.join(INTEGRATORS)})"
+            )
         # equation-family validation (unknown family/params, unsupported
-        # stencil kind) — lazy import like StencilConfig's STENCILS check
+        # stencil kind, integrator/family coupling) — lazy import like
+        # StencilConfig's STENCILS check
         from heat3d_tpu import eqn
 
         eqn.validate_config(self)

@@ -70,19 +70,60 @@ def build_spec(cfg) -> EquationSpec:
     return fam.build(cfg.stencil.kind, resolved_params(cfg), cfg.grid.alpha)
 
 
+# Families whose explicit update operator is SYMMETRIC (no advection /
+# odd-derivative term): the matrix-free CG solve (integrator=implicit-cg,
+# heat3d_tpu.timeint.cg) requires a symmetric positive-definite system
+# A = 2I - T, which an asymmetric T cannot provide.
+CG_FAMILIES = ("heat", "aniso-diffusion", "reaction-diffusion")
+
+
+def _validate_integrator(cfg, fam) -> None:
+    """Integrator/family coupling (docs/INTEGRATORS.md): the wave family
+    is second order in time and exists only under the two-level leapfrog
+    carry; conversely leapfrog integrates nothing else. implicit-cg is
+    restricted to symmetric operators (CG_FAMILIES) — and is the one
+    integrator for which a dt above the family's explicit bound is the
+    POINT, so the default-dt stability check stands down for it."""
+    ti = getattr(cfg, "integrator", "explicit-euler")
+    if fam.name == "wave" and ti != "leapfrog":
+        raise ValueError(
+            f"equation 'wave' is second order in time: it needs the "
+            f"two-level leapfrog carry (integrator='leapfrog'), got "
+            f"integrator={ti!r} (docs/INTEGRATORS.md)"
+        )
+    if ti == "leapfrog" and fam.name != "wave":
+        raise ValueError(
+            f"integrator='leapfrog' integrates the wave family's "
+            f"second-order-in-time operator; {fam.name!r} is first order "
+            "in time — use explicit-euler or implicit-cg "
+            "(docs/INTEGRATORS.md)"
+        )
+    if ti == "implicit-cg" and fam.name not in CG_FAMILIES:
+        raise ValueError(
+            f"integrator='implicit-cg' needs a symmetric operator "
+            f"(families {CG_FAMILIES}); {fam.name!r} breaks the "
+            "conjugate-gradient symmetry contract (docs/INTEGRATORS.md)"
+        )
+
+
 def validate_config(cfg) -> None:
     """Config-time validation: family known, stencil kind supported,
-    params resolvable — and, for non-heat families with a DEFAULT
-    (dt=None) timestep, the derived dt must respect the family's own
-    explicit-Euler stability bound. ``GridConfig.effective_dt`` only
-    knows the diffusion operator, so a strong reaction/advection term
-    would otherwise let a default-dt run diverge silently (residual inf,
-    rc 0); an EXPLICIT dt stays the author's contract
-    (docs/EQUATIONS.md "Authoring guide"). Raises ValueError with the
-    production message — SolverConfig.__post_init__ calls this so a bad
-    --equation fails in ms, not at step-build time."""
+    params resolvable, integrator/family coupling sound — and, for
+    non-heat families with a DEFAULT (dt=None) timestep, the derived dt
+    must respect the family's own explicit-Euler stability bound.
+    ``GridConfig.effective_dt`` only knows the diffusion operator, so a
+    strong reaction/advection term would otherwise let a default-dt run
+    diverge silently (residual inf, rc 0); an EXPLICIT dt stays the
+    author's contract (docs/EQUATIONS.md "Authoring guide"). The
+    implicit-cg integrator is unconditionally stable, so the bound check
+    stands down for it. Raises ValueError with the production message —
+    SolverConfig.__post_init__ calls this so a bad --equation fails in
+    ms, not at step-build time."""
     build_spec(cfg)
     fam = family_for(cfg)
+    _validate_integrator(cfg, fam)
+    if getattr(cfg, "integrator", "explicit-euler") == "implicit-cg":
+        return
     if cfg.equation != "heat" and cfg.grid.dt is None and callable(
         fam.stable_dt
     ):

@@ -18,6 +18,7 @@ battery proves it e2e). The new families ride the same machinery:
 - ``aniso-diffusion``   du/dt = alpha * div(D grad u), D = diag(dx,dy,dz)
 - ``advection-diffusion`` du/dt = alpha * lap(u) - v . grad(u)
 - ``reaction-diffusion``  du/dt = alpha * lap(u) + rate * u   (linear)
+- ``wave``              d2u/dt2 = c^2 * lap(u)   (leapfrog two-level carry)
 """
 
 from __future__ import annotations
@@ -201,6 +202,50 @@ def _advdiff_rates(params, alpha, k):
     return mu, omega
 
 
+# ---- wave (second order in time; leapfrog-integrated) -----------------------
+
+
+def _build_wave(kind, params, alpha) -> EquationSpec:
+    c = params["c"]
+    if c <= 0.0:
+        raise ValueError(f"wave needs a positive speed c, got c={c}")
+    s = STENCILS[kind]
+    # the spatial operator is c^2 * lap(u); grid.alpha is a DIFFUSION
+    # knob and deliberately does not enter (the wave speed is the
+    # family's own parameter, like advection's velocity)
+    return EquationSpec(
+        family="wave",
+        terms=(
+            Term(
+                name="wave-laplacian",
+                coeff=c * c,
+                op=StencilSpec(
+                    weights=s.weights,
+                    scaling=(
+                        "laplacian-separable"
+                        if s.separable
+                        else "laplacian-uniform"
+                    ),
+                ),
+            ),
+        ),
+    )
+
+
+def _wave_rates(params, alpha, k):
+    # d2u/dt2 = c^2 lap(u): sin(k.x - omega t) is exact at omega = c|k|,
+    # with zero decay — the leapfrog MMS reference
+    return 0.0, params["c"] * float(np.sqrt(sum(kk * kk for kk in k)))
+
+
+def _wave_stable_dt(params, alpha, spacing):
+    # leapfrog CFL: dt^2 * lambda_max <= 4 with
+    # lambda_max(-c^2 lap_h) = c^2 * sum_a 4/h_a^2
+    return 1.0 / (
+        params["c"] * float(np.sqrt(sum(1.0 / h**2 for h in spacing)))
+    )
+
+
 # ---- reaction-diffusion (linear reaction) -----------------------------------
 
 
@@ -258,6 +303,17 @@ FAMILIES: Dict[str, EquationFamily] = {
             build=_build_advdiff,
             mms_rates=_advdiff_rates,
             stable_dt=_advdiff_stable_dt,
+        ),
+        EquationFamily(
+            name="wave",
+            description="second-order wave equation d2u/dt2 = c^2*lap(u), "
+            "leapfrog-integrated over the two-level (u, u_prev) carry "
+            "(integrator='leapfrog'; docs/INTEGRATORS.md)",
+            kinds=("7pt", "27pt"),
+            defaults=(("c", 1.0),),
+            build=_build_wave,
+            mms_rates=_wave_rates,
+            stable_dt=_wave_stable_dt,
         ),
         EquationFamily(
             name="reaction-diffusion",

@@ -132,19 +132,28 @@ class HeatSolver3D:
         # run_start events record concrete routes. resolve_config fails
         # soft; the belt-and-braces fallback below covers even an
         # unimportable tune package (the solver must never require it).
-        try:
-            from heat3d_tpu.tune.cache import resolve_config
+        # Non-default integrators pin their autos directly instead (the
+        # tuner's cached knobs describe the explicit program family) and
+        # validate against the timeint builders' structural scope.
+        if cfg.integrator != "explicit-euler":
+            from heat3d_tpu import timeint
 
-            cfg = resolve_config(cfg)
-        except Exception:  # noqa: BLE001 - resolution is optional
-            if cfg.halo == "auto" or cfg.time_blocking == 0:
-                cfg = dataclasses.replace(
-                    cfg,
-                    halo="ppermute" if cfg.halo == "auto" else cfg.halo,
-                    time_blocking=(
-                        1 if cfg.time_blocking == 0 else cfg.time_blocking
-                    ),
-                )
+            cfg = timeint.pin_config(cfg)
+            timeint.validate_config(cfg)
+        else:
+            try:
+                from heat3d_tpu.tune.cache import resolve_config
+
+                cfg = resolve_config(cfg)
+            except Exception:  # noqa: BLE001 - resolution is optional
+                if cfg.halo == "auto" or cfg.time_blocking == 0:
+                    cfg = dataclasses.replace(
+                        cfg,
+                        halo="ppermute" if cfg.halo == "auto" else cfg.halo,
+                        time_blocking=(
+                            1 if cfg.time_blocking == 0 else cfg.time_blocking
+                        ),
+                    )
         if cfg.halo == "dma":
             platform = jax.devices()[0].platform
             # The fused DMA-overlap routes (overlap=True) have an off-TPU
@@ -169,6 +178,27 @@ class HeatSolver3D:
         self.cfg = cfg
         self.mesh = build_mesh(cfg.mesh, devices)
         self.sharding = field_sharding(self.mesh, cfg.mesh)
+        # Built on first use: the fixed-step loop validates time_blocking
+        # constraints (halo transport, local extents) that convergence-mode
+        # runs never exercise.
+        self._multistep_cache = None
+        self._device_field_cache = {}
+        if cfg.integrator != "explicit-euler":
+            from heat3d_tpu import timeint
+
+            self._compute = None
+            self._step = jax.jit(
+                timeint.make_step_fn(cfg, self.mesh), donate_argnums=0
+            )
+            self._step_res = jax.jit(
+                timeint.make_step_fn(cfg, self.mesh, with_residual=True),
+                donate_argnums=0,
+            )
+            # convergence mode is steady-state machinery: wave runs
+            # oscillate forever and an implicit solve's change residual
+            # measures dt, not proximity to steady state
+            self._converge = None
+            return
         compute = _select_backend(cfg)
         self._compute = compute
         # One executable per entrypoint; donation makes the time loop
@@ -180,11 +210,6 @@ class HeatSolver3D:
             make_step_fn(cfg, self.mesh, compute, with_residual=True),
             donate_argnums=0,
         )
-        # Built on first use: the fixed-step loop validates time_blocking
-        # constraints (halo transport, local extents) that convergence-mode
-        # runs never exercise.
-        self._multistep_cache = None
-        self._device_field_cache = {}
         self._converge = jax.jit(
             make_converge_fn(cfg, self.mesh, compute), donate_argnums=0
         )
@@ -192,10 +217,18 @@ class HeatSolver3D:
     @property
     def _multistep(self):
         if self._multistep_cache is None:
-            self._multistep_cache = jax.jit(
-                make_multistep_fn(self.cfg, self.mesh, self._compute),
-                donate_argnums=0,
-            )
+            if self.cfg.integrator != "explicit-euler":
+                from heat3d_tpu import timeint
+
+                self._multistep_cache = jax.jit(
+                    timeint.make_multistep_fn(self.cfg, self.mesh),
+                    donate_argnums=0,
+                )
+            else:
+                self._multistep_cache = jax.jit(
+                    make_multistep_fn(self.cfg, self.mesh, self._compute),
+                    donate_argnums=0,
+                )
         return self._multistep_cache
 
     # ---- state -----------------------------------------------------------
@@ -222,7 +255,26 @@ class HeatSolver3D:
 
         Storage is ``cfg.padded_shape``; for uneven decompositions the
         region beyond ``cfg.grid.shape`` is pinned at bc_value (see
-        parallel.step._pin_padding)."""
+        parallel.step._pin_padding).
+
+        Under ``integrator='leapfrog'`` the state is the TWO-LEVEL carry
+        ``(u, u_prev)``: a single initializer yields a zero-velocity
+        start (u_prev a copy of u — distinct buffers, so the donated
+        step may alias either); a TUPLE of two initializers sets the
+        levels independently (the MMS gates seed u(0) and u(-dt))."""
+        if self.cfg.integrator == "leapfrog":
+            if isinstance(init, tuple):
+                if len(init) != 2:
+                    raise ValueError(
+                        f"leapfrog init tuple must have 2 levels (u, "
+                        f"u_prev), got {len(init)}"
+                    )
+                return tuple(self._init_level(lv) for lv in init)
+            u0 = self._init_level(init)
+            return (u0, jnp.copy(u0))
+        return self._init_level(init)
+
+    def _init_level(self, init: Union[str, np.ndarray]) -> jax.Array:
         true_shape = self.cfg.grid.shape
         with obs.get().span(
             "init_state",
@@ -317,14 +369,21 @@ class HeatSolver3D:
     def zeros_state(self) -> jax.Array:
         """An all-zero TRUE grid in storage layout (padding at bc_value) —
         cheap warmup input for the donated executables. Built on device
-        (no host buffer, no transfer) unless HEAT3D_DEVICE_INIT=0."""
+        (no host buffer, no transfer) unless HEAT3D_DEVICE_INIT=0. A
+        two-level tuple under ``integrator='leapfrog'``, like
+        :meth:`init_state`."""
         if _device_init_enabled():
-            return self._device_field(hot_cube=False)
-        return self._sharded_from_blocks(
-            lambda clipped: np.zeros(
-                tuple(c.stop - c.start for c in clipped), self.storage_dtype
+            z = self._device_field(hot_cube=False)
+        else:
+            z = self._sharded_from_blocks(
+                lambda clipped: np.zeros(
+                    tuple(c.stop - c.start for c in clipped),
+                    self.storage_dtype,
+                )
             )
-        )
+        if self.cfg.integrator == "leapfrog":
+            return (z, jnp.copy(z))
+        return z
 
     # ---- stepping --------------------------------------------------------
 
@@ -336,12 +395,34 @@ class HeatSolver3D:
 
     def run(self, u: jax.Array, num_steps: int) -> jax.Array:
         """num_steps updates as one device-side loop (benchmark mode: no
-        mid-loop host syncs — SURVEY.md §3.3)."""
+        mid-loop host syncs — SURVEY.md §3.3). Under
+        ``integrator='implicit-cg'`` each update is a CG solve; the last
+        solve's iteration count and relative residual come back with the
+        field and land in the ledger as a ``cg_solve`` event (the one
+        host sync happens after the loop, where the caller consumes the
+        field anyway)."""
+        if self.cfg.integrator == "implicit-cg":
+            u, iters, relres = self._multistep(u, jnp.int32(num_steps))
+            obs.get().event(
+                "cg_solve",
+                steps=int(num_steps),
+                cg_iters=int(iters),
+                cg_relres=float(relres),
+            )
+            return u
         return self._multistep(u, jnp.int32(num_steps))
 
     def run_to_convergence(
         self, u: jax.Array, tol: float, max_steps: int
     ) -> RunResult:
+        if self._converge is None:
+            raise ValueError(
+                f"run_to_convergence needs integrator='explicit-euler' "
+                f"(got {self.cfg.integrator!r}): wave runs oscillate "
+                "instead of converging, and an implicit solve's change "
+                "residual measures dt, not steady-state proximity — use "
+                "fixed-step run() (docs/INTEGRATORS.md)"
+            )
         u, steps, res = self._converge(u, jnp.int32(max_steps), jnp.float32(tol))
         return RunResult(u=u, steps=int(steps), residual=float(res))
 
@@ -383,7 +464,10 @@ class HeatSolver3D:
         """Fetch the full field to host (small grids / tests only), with any
         uneven-decomposition storage padding stripped. Multi-host safe: when
         shards live on other processes this is a collective
-        (process_allgather), so every process must call it."""
+        (process_allgather), so every process must call it. A multi-level
+        carry gathers level 0 (the current field)."""
+        if isinstance(u, tuple):
+            u = u[0]
         if u.is_fully_addressable:
             full = np.asarray(jax.device_get(u))
         else:
@@ -402,6 +486,8 @@ class HeatSolver3D:
         ``axis``. Multi-host safe: the replicated out_sharding makes XLA
         gather just this plane to every process, so all processes must
         call it (like :meth:`gather`)."""
+        if isinstance(u, tuple):
+            u = u[0]
         g = self.cfg.grid.shape
         if not 0 <= axis <= 2:
             raise ValueError(f"slice axis must be 0..2, got {axis}")
@@ -447,10 +533,89 @@ class HeatSolver3D:
         # strip any uneven-decomposition storage padding from the plane
         return np.asarray(plane)[: keep[0], : keep[1]]
 
-    def save_checkpoint(self, path: str, u: jax.Array, step: int) -> None:
-        ckpt.save(path, u, step, extra={"config": repr(self.cfg)})
+    def save_checkpoint(self, path: str, u, step: int) -> None:
+        """Checkpoint the state. A multi-level carry (leapfrog) writes
+        level 0 at the generation top — manifest extra records
+        ``levels``/``integrator`` — and each further level as a full
+        per-shard checkpoint under ``<path>/level-<i>/``, so every level
+        keeps the per-shard CRC sidecars and the cross-mesh re-stitch of
+        ``utils.checkpoint`` unchanged."""
+        import os
 
-    def load_checkpoint(self, path: str) -> Tuple[jax.Array, int]:
+        from heat3d_tpu import timeint
+
+        levels = timeint.carry_levels(self.cfg.integrator)
+        if levels == 1:
+            ckpt.save(path, u, step, extra={"config": repr(self.cfg)})
+            return
+        ckpt.save(
+            path,
+            u[0],
+            step,
+            extra={
+                "config": repr(self.cfg),
+                "levels": levels,
+                "integrator": self.cfg.integrator,
+            },
+        )
+        for lv in range(1, levels):
+            ckpt.save(
+                os.path.join(path, f"level-{lv}"),
+                u[lv],
+                step,
+                extra={"level": lv, "integrator": self.cfg.integrator},
+            )
+
+    def load_checkpoint(self, path: str):
+        """Load a checkpoint saved by :meth:`save_checkpoint`. The level
+        structure is validated BEFORE any shard read: a manifest whose
+        ``levels`` count disagrees with this integrator's carry, a
+        missing level directory, a level step drift, or a per-level
+        shard-shape mismatch raises
+        :class:`heat3d_tpu.timeint.MultiLevelCheckpointError` — a
+        ValueError, so the supervisor's resume scan skips the generation
+        in place (the shards are not PROVEN corrupt; quarantine stays
+        reserved for checksum/torn-manifest damage)."""
+        import os
+
+        from heat3d_tpu import timeint
+
+        levels = timeint.carry_levels(self.cfg.integrator)
+        man = ckpt.load_manifest(path)
+        found = int((man.get("extra") or {}).get("levels", 1))
+        if found != levels:
+            raise timeint.MultiLevelCheckpointError(
+                f"checkpoint {path} holds {found} field level(s) but "
+                f"integrator {self.cfg.integrator!r} carries {levels} — "
+                "wrong integrator for this checkpoint (docs/INTEGRATORS.md)"
+            )
+        u, step = self._load_level(path)
+        if levels == 1:
+            return u, step
+        state = [u]
+        for lv in range(1, levels):
+            lp = os.path.join(path, f"level-{lv}")
+            try:
+                ulv, step_lv = self._load_level(
+                    lp, error_cls=timeint.MultiLevelCheckpointError
+                )
+            except ckpt.ShardCorruptError:
+                raise  # proven damage: let the supervisor quarantine
+            except FileNotFoundError as e:
+                raise timeint.MultiLevelCheckpointError(
+                    f"checkpoint {path} is missing level {lv} "
+                    f"({lp}): {e}"
+                ) from e
+            if step_lv != step:
+                raise timeint.MultiLevelCheckpointError(
+                    f"checkpoint {path} level {lv} is at step {step_lv} "
+                    f"but level 0 is at step {step} — torn multi-level "
+                    "save"
+                )
+            state.append(ulv)
+        return tuple(state), step
+
+    def _load_level(self, path: str, error_cls=ValueError):
         u, step, _ = ckpt.load(path, self.sharding)
         if tuple(u.shape) != self.cfg.padded_shape:
             # fail loudly: silently stepping a wrong-shape field would
@@ -474,7 +639,7 @@ class HeatSolver3D:
                 if same_grid_other_padding
                 else "wrong checkpoint for this run"
             )
-            raise ValueError(
+            raise error_cls(
                 f"checkpoint {path} holds a {tuple(u.shape)} field but "
                 f"this config's storage shape is {self.cfg.padded_shape} "
                 f"(grid {self.cfg.grid.shape} on mesh {self.cfg.mesh.shape})"

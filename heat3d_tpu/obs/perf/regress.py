@@ -100,6 +100,11 @@ def row_key(row: Dict[str, Any]) -> Optional[Tuple]:
             # cross-compare with heat — rows predating the field are
             # heat by construction (only heat existed)
             row.get("equation", "heat"),
+            # time-integrator leg (PR 19): a CG solve or two-level
+            # leapfrog step must never cross-compare with the explicit
+            # sweep — rows predating the field are explicit-euler by
+            # construction (only it existed)
+            row.get("integrator", "explicit-euler"),
             tuple(row.get("grid") or ()),
             tuple(row.get("mesh") or ()),
             row.get("dtype"),

@@ -135,10 +135,18 @@ def row_key(cfg, bench: str = "throughput") -> str:
     # byte-identical, and a spec-built family's stage can never collide
     # with the heat stage of the same shape
     eq = "" if cfg.equation == "heat" else f":eq{cfg.equation}"
+    # time-integrator leg (same non-default suffix rule): a leapfrog or
+    # CG stage of the same shape must not resume an explicit-euler row,
+    # while every pre-timeint journal key stays byte-identical
+    ti = (
+        ""
+        if cfg.integrator == "explicit-euler"
+        else f":ti{cfg.integrator}"
+    )
     return (
         f"{bench}:g{g}:m{m}:{cfg.stencil.kind}:{cfg.precision.storage}"
         f":c{cfg.precision.compute}:b{cfg.backend}:tb{cfg.time_blocking}"
-        f":ov{int(cfg.overlap)}:h{cfg.halo}{ho}{hp}{eq}"
+        f":ov{int(cfg.overlap)}:h{cfg.halo}{ho}{hp}{eq}{ti}"
         + (f":env[{env_bits}]" if env_bits else "")
     )
 

@@ -121,6 +121,10 @@ def aot_key(solver) -> str:
         "bind": solver.bind,
         "tune_key": tcache.cache_key(solver.cfg, batch_size=solver.B),
         "mehrstellen": bool(solver._mehrstellen),
+        # variable-coefficient batches compile a different program
+        # signature (the field array is a traced input) — never
+        # warm-hit across the routes
+        "coef_fields": bool(getattr(solver, "_varcoef", False)),
         "time_blocking": solver.cfg.time_blocking,
         # the exchange schedule legs: effective mode folds HEAT3D_NO_PLAN
         # in (parallel.plan's one rule); the floor changes which faces

@@ -83,6 +83,10 @@ def bench_ensemble_throughput(
         # equation-family provenance, same contract as the solo harness
         # rows (check_provenance requires it; regress keys on it)
         "equation": cfg.equation,
+        # integrator provenance (REQUIRED by check_provenance.py on every
+        # throughput row): the ensemble packs the explicit sweep only,
+        # but the row says so explicitly rather than by omission
+        "integrator": cfg.integrator,
         "mesh": list(cfg.mesh.shape),
         "dtype": cfg.precision.storage,
         "compute_dtype": cfg.precision.compute,

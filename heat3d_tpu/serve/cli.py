@@ -91,6 +91,16 @@ def _eq_pairs(rec: dict) -> tuple:
 def _scenario_from_record(rec: dict):
     from heat3d_tpu.serve.scenario import Scenario
 
+    # coef_field arrives as ["name", seed, lo, hi] (prefixes allowed) or a
+    # bare "name"; integrator as a string. Both bucket the request apart
+    # from plain ones (scenario.request_bucket_key), so passing them
+    # through here is what keeps a varcoef request from silently packing
+    # with — and being served as — a constant-coefficient member.
+    cf = rec.get("coef_field")
+    if isinstance(cf, str):
+        cf = (cf,)
+    elif cf is not None:
+        cf = tuple(cf)
     return Scenario(
         init=rec.get("init", "hot-cube"),
         alpha=float(rec.get("alpha", 1.0)),
@@ -99,6 +109,8 @@ def _scenario_from_record(rec: dict):
         steps=rec.get("steps"),
         seed=int(rec.get("seed", 0)),
         eq_params=_eq_pairs(rec),
+        integrator=rec.get("integrator"),
+        coef_field=cf,
     )
 
 

@@ -103,7 +103,11 @@ from heat3d_tpu.serve.queue import (
     pad_batch,
     run_packed_batch,
 )
-from heat3d_tpu.serve.scenario import Scenario, solver_bucket_key
+from heat3d_tpu.serve.scenario import (
+    Scenario,
+    request_bucket_key,
+    solver_bucket_key,
+)
 from heat3d_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -487,7 +491,7 @@ class AsyncServeEngine:
                     trace=trace,
                 )
                 self._streams.setdefault(stream, []).append(rid)
-                bucket = str(solver_bucket_key(base))
+                bucket = str(request_bucket_key(base, scenario))
                 self._bucket_base.setdefault(bucket, base)
                 hist = self._arrival_history.setdefault(bucket, [])
                 hist.append(time.monotonic())
@@ -576,7 +580,11 @@ class AsyncServeEngine:
         deterministic for the single-stream acceptance runs."""
         by_bucket: Dict[str, List[_Tracked]] = {}
         for r in self._undispatched():
-            by_bucket.setdefault(str(solver_bucket_key(r.base)), []).append(r)
+            # request-level key: integrator/coef-field requests must
+            # never pack with the plain sweep of the same base
+            by_bucket.setdefault(
+                str(request_bucket_key(r.base, r.scenario)), []
+            ).append(r)
         out: List[Tuple[_BucketWorker, List[_Tracked]]] = []
         for bucket, reqs in by_bucket.items():
             if bucket in self._busy:

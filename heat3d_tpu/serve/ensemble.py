@@ -147,6 +147,22 @@ class EnsembleSolver:
         self.bind = bind
         self.batch_mesh = int(batch_mesh)
         cfg = _resolve_base(batch.base, self.B)
+        if cfg.integrator != "explicit-euler":
+            raise ValueError(
+                f"integrator={cfg.integrator!r}: the ensemble packs the "
+                "explicit sweep (and its variable-coefficient flux form); "
+                "the leapfrog two-level carry and the CG solve are "
+                "single-tenant — run them through HeatSolver3D "
+                "(docs/INTEGRATORS.md)"
+            )
+        self._varcoef = bool(getattr(batch, "has_coef_fields", False))
+        if self._varcoef and bind != "traced":
+            raise ValueError(
+                "coefficient-field members need bind='traced': the field "
+                "arrays are runtime inputs of one shared program (the "
+                "baked binding dispatches constant-coefficient solo "
+                "executables)"
+            )
         if cfg.backend in ("pallas", "conv"):
             # only an EXPLICIT kernel/conv request reaches here —
             # 'auto' was pinned to the chain before cache resolution
@@ -175,6 +191,13 @@ class EnsembleSolver:
         # the ensemble's compute route is the chain; record it concretely
         cfg = dataclasses.replace(cfg, backend="jnp")
         k = cfg.time_blocking
+        if self._varcoef and k > 1:
+            raise ValueError(
+                f"time_blocking={k} with coefficient fields: the "
+                "superstep ring recompute carries the solution only — "
+                "the flux form needs the field's ghosts every update "
+                "(tb=1; docs/INTEGRATORS.md)"
+            )
         if k > 1 and min(cfg.local_shape) < max(3, k):
             raise ValueError(
                 f"time_blocking={k} needs local extents >= {max(3, k)} "
@@ -221,6 +244,39 @@ class EnsembleSolver:
         cfg = self.cfg
         compute_dtype = jnp.dtype(cfg.precision.compute)
         storage_dtype = jnp.dtype(cfg.precision.storage)
+        self.budgets = np.asarray(
+            [self.batch.member_steps(m) for m in range(self.B)],
+            dtype=np.int32,
+        )
+        self._BCV = np.asarray(
+            [m.bc_value for m in self.batch.members], dtype=np.float64
+        ).astype(storage_dtype)
+        self._BCV_dev = jax.device_put(
+            jnp.asarray(self._BCV), self._member_spec
+        )
+        if self._varcoef:
+            # per-member coefficient FIELDS, (B, *padded), sharded like
+            # the solution. Storage padding stays ZERO: a=0 kills the
+            # face flux out of the pad — the same Dirichlet rule the
+            # ghost exchange applies (timeint.coeffield). Fields are
+            # deterministic from the scenario spec tuples, so rebinds
+            # and supervised restarts rebuild them — never checkpointed.
+            A = np.zeros((self.B,) + tuple(cfg.padded_shape), np.float64)
+            sl = tuple(slice(0, g) for g in cfg.grid.shape)
+            for m in range(self.B):
+                A[(m,) + sl] = self.batch.member_coef_field(m)
+            self._A = A.astype(storage_dtype)
+            self._DT = np.asarray(
+                [self.batch.member_dt(m) for m in range(self.B)],
+                dtype=np.float64,
+            ).astype(compute_dtype)
+            self._W = self._COEF = None
+            self._mehrstellen = False
+            self._A_dev = jax.device_put(jnp.asarray(self._A), self.sharding)
+            self._DT_dev = jax.device_put(
+                jnp.asarray(self._DT), self._member_spec
+            )
+            return
         nominal = _solver_taps(cfg)
         self._flat = flat_taps(nominal)
         positions = emission_positions(self._flat)
@@ -234,13 +290,6 @@ class EnsembleSolver:
             ],
             dtype=np.float64,
         ).astype(compute_dtype)
-        self._BCV = np.asarray(
-            [m.bc_value for m in self.batch.members], dtype=np.float64
-        ).astype(storage_dtype)
-        self.budgets = np.asarray(
-            [self.batch.member_steps(m) for m in range(self.B)],
-            dtype=np.int32,
-        )
         # the separable S+F route follows the same env gate as the solo
         # apply; members share decomposability (same stencil kind, same
         # footprint), so the route is uniform across the batch
@@ -261,9 +310,6 @@ class EnsembleSolver:
             jax.device_put(jnp.asarray(self._COEF), self._member_spec)
             if self._COEF is not None
             else jnp.zeros((self.B, 1), jnp.float32)  # placeholder, unused
-        )
-        self._BCV_dev = jax.device_put(
-            jnp.asarray(self._BCV), self._member_spec
         )
 
     @property
@@ -327,8 +373,13 @@ class EnsembleSolver:
     # ---- compiled programs ------------------------------------------------
 
     def _coef_args(self):
-        """(W, COEF, BCV) device arrays, sharded over the batch axis —
-        uploaded once per coefficient (re)bind in _build_coefficients."""
+        """The coefficient-argument triple, uploaded once per (re)bind
+        in _build_coefficients: ``(W, COEF, BCV)`` on the constant-
+        coefficient route, ``(A, DT, BCV)`` on the variable-coefficient
+        one — same arity, so the compiled-program plumbing (run /
+        residual / IR / AOT) is route-agnostic."""
+        if self._varcoef:
+            return self._A_dev, self._DT_dev, self._BCV_dev
         return self._W_dev, self._C_dev, self._BCV_dev
 
     def _build_programs(self) -> None:
@@ -339,8 +390,25 @@ class EnsembleSolver:
         spatial_axes = cfg.mesh.axis_names
 
         if self.bind == "traced":
-            step_v = self._vmapped(self._member_step)
-            super_v = self._vmapped(self._member_superstep)
+            if self._varcoef:
+                # variable-coefficient flux form: the member update is
+                # timeint.coeffield's local step with the member's FIELD
+                # shard, dt, and boundary value all traced; k==1 is
+                # enforced at construction, so the superstep IS the step
+                from heat3d_tpu.timeint.coeffield import _local_flux_update
+
+                def member_vc(u, a, dtm, bcv):
+                    return _local_flux_update(
+                        u, a, cfg, dtm, exchange_with_plan, bc_value=bcv
+                    )
+
+                def step_v(u_b, A_b, DT_b, bc_b):
+                    return jax.vmap(member_vc)(u_b, A_b, DT_b, bc_b)
+
+                super_v = step_v
+            else:
+                step_v = self._vmapped(self._member_step)
+                super_v = self._vmapped(self._member_superstep)
 
             def local_run(u_b, W_b, C_b, bc_b, budget_b):
                 # loop bounds must be SPMD-uniform: a device's local
@@ -379,7 +447,13 @@ class EnsembleSolver:
                 )(new, u_b)
                 return new, lax.psum(r, spatial_axes)
 
-            coef_specs = (mspec, mspec, mspec)
+            # the field array shards like the solution; scalar
+            # per-member coefficients shard over the batch axis only
+            coef_specs = (
+                (spec, mspec, mspec)
+                if self._varcoef
+                else (mspec, mspec, mspec)
+            )
             self._run_p = jax.jit(
                 shard_map(
                     local_run,

@@ -39,7 +39,12 @@ from heat3d_tpu import obs
 from heat3d_tpu.core.config import SolverConfig
 from heat3d_tpu.obs.metrics import HISTOGRAM_SAMPLE_CAP
 from heat3d_tpu.serve.ensemble import EnsembleSolver
-from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch, solver_bucket_key
+from heat3d_tpu.serve.scenario import (
+    Scenario,
+    ScenarioBatch,
+    request_bucket_key,
+    solver_bucket_key,
+)
 from heat3d_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -593,7 +598,10 @@ class ScenarioQueue:
     def _buckets(self) -> "OrderedDict[Tuple, List[_Pending]]":
         out: "OrderedDict[Tuple, List[_Pending]]" = OrderedDict()
         for p in self._pending.values():
-            out.setdefault(solver_bucket_key(p.base), []).append(p)
+            # the request-level key: a scenario stating its own
+            # integrator (or carrying a coefficient field) must never
+            # share a batch with the base's plain explicit sweep
+            out.setdefault(request_bucket_key(p.base, p.scenario), []).append(p)
         return out
 
     def _solver_for(

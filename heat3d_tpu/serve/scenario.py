@@ -49,8 +49,55 @@ class Scenario:
     # values that change which taps are nonzero fail loudly at batch
     # construction.
     eq_params: Tuple[Tuple[str, float], ...] = ()
+    # Which time integrator this request wants (core.config.INTEGRATORS;
+    # None = the batch base's). One compiled program runs ONE integrator,
+    # so the queue buckets on it (solver_bucket_key) and ScenarioBatch
+    # requires every member that states one to agree.
+    integrator: Optional[str] = None
+    # Per-member spatially-varying diffusivity: a coefficient-FIELD spec
+    # tuple ``(name, seed, lo, hi)`` resolved by
+    # ``timeint.coeffield.make_coef_field`` (name alone or a prefix is
+    # accepted; defaults seed=0, lo=0.5, hi=1.5). The field replaces the
+    # member's scalar alpha in the flux-form update and rides the traced
+    # bind as a runtime input; all-or-none across a batch (the varcoef
+    # program has a different input signature).
+    coef_field: Optional[Tuple] = None
 
     def __post_init__(self):
+        if self.integrator is not None:
+            from heat3d_tpu.core.config import INTEGRATORS
+
+            if self.integrator not in INTEGRATORS:
+                raise ValueError(
+                    f"scenario integrator {self.integrator!r} not in "
+                    f"{INTEGRATORS}"
+                )
+        if self.coef_field is not None:
+            cf = self.coef_field
+            if isinstance(cf, str):
+                cf = (cf,)
+            cf = tuple(cf)
+            if not 1 <= len(cf) <= 4:
+                raise ValueError(
+                    f"coef_field must be (name[, seed[, lo[, hi]]]), got "
+                    f"{self.coef_field!r}"
+                )
+            name = str(cf[0])
+            seed = int(cf[1]) if len(cf) > 1 else 0
+            lo = float(cf[2]) if len(cf) > 2 else 0.5
+            hi = float(cf[3]) if len(cf) > 3 else 1.5
+            from heat3d_tpu.timeint.coeffield import COEF_FIELDS
+
+            if name not in COEF_FIELDS:
+                raise ValueError(
+                    f"unknown coefficient field {name!r}; have "
+                    f"{COEF_FIELDS}"
+                )
+            if not 0.0 < lo <= hi:
+                raise ValueError(
+                    f"coef_field needs 0 < lo <= hi, got lo={lo} hi={hi}"
+                )
+            object.__setattr__(self, "coef_field", (name, seed, lo, hi))
         if self.alpha <= 0.0:
             raise ValueError(
                 f"scenario alpha must be > 0, got {self.alpha} (alpha*dt=0 "
@@ -85,6 +132,41 @@ class ScenarioBatch:
         members = tuple(members)
         if not members:
             raise ValueError("a ScenarioBatch needs at least one scenario")
+        # integrator consistency: one compiled program runs ONE
+        # integrator, so members that state one must agree — and the
+        # stated one becomes the batch's effective base integrator
+        # (requests carrying an integrator bucket apart via
+        # solver_bucket_key before they ever reach a batch)
+        stated = {m.integrator for m in members if m.integrator is not None}
+        if len(stated) > 1:
+            raise ValueError(
+                f"members of one batch state conflicting integrators "
+                f"{sorted(stated)} — one compiled program runs one "
+                "integrator (the queue buckets on it; these requests "
+                "should never have shared a batch)"
+            )
+        if stated:
+            ti = stated.pop()
+            if ti != base.integrator:
+                base = dataclasses.replace(base, integrator=ti)
+        # coefficient fields: all-or-none (the varcoef program takes the
+        # field array as an extra runtime input — a mixed batch has no
+        # single program signature), and only on the explicit heat sweep
+        with_cf = sum(1 for m in members if m.coef_field is not None)
+        if with_cf not in (0, len(members)):
+            raise ValueError(
+                f"{with_cf}/{len(members)} members carry coef_field — "
+                "coefficient fields are all-or-none across a batch (the "
+                "varcoef program has a different input signature)"
+            )
+        self.has_coef_fields = with_cf == len(members)
+        if self.has_coef_fields:
+            if base.equation != "heat" or base.integrator != "explicit-euler":
+                raise ValueError(
+                    "coef_field members need the explicit-euler heat "
+                    f"sweep, got equation={base.equation!r} "
+                    f"integrator={base.integrator!r} (docs/INTEGRATORS.md)"
+                )
         self.base = base
         self.members = members
         self._check_footprints()
@@ -98,6 +180,14 @@ class ScenarioBatch:
         m = self.members[i]
         if m.dt is not None:
             return m.dt
+        if m.coef_field is not None:
+            # flux-form explicit bound at the field's MAX (hi clip):
+            # same 0.9x safety rule as GridConfig.effective_dt
+            from heat3d_tpu.timeint.coeffield import varcoef_stable_dt
+
+            return 0.9 * varcoef_stable_dt(
+                m.coef_field[3], self.base.grid.spacing
+            )
         g = dataclasses.replace(self.base.grid, alpha=m.alpha, dt=None)
         return g.effective_dt()
 
@@ -127,6 +217,20 @@ class ScenarioBatch:
     def member_steps(self, i: int) -> int:
         m = self.members[i]
         return self.base.run.num_steps if m.steps is None else m.steps
+
+    def member_coef_field(self, i: int) -> np.ndarray:
+        """Member ``i``'s resolved fp64 coefficient field on the TRUE
+        grid (deterministic from the spec tuple — rebuilt, never
+        checkpointed)."""
+        m = self.members[i]
+        if m.coef_field is None:
+            raise ValueError(f"scenario {i} carries no coef_field")
+        from heat3d_tpu.timeint.coeffield import make_coef_field
+
+        name, seed, lo, hi = m.coef_field
+        return make_coef_field(
+            name, self.base.grid.shape, seed=seed, lo=lo, hi=hi
+        )
 
     def member_taps(self, i: int) -> np.ndarray:
         """Member ``i``'s lowered update taps, via the equation frontend
@@ -163,8 +267,30 @@ class ScenarioBatch:
         """The structural compatibility key: scenarios whose batches share
         this key can be packed into ONE compiled ensemble program (the
         per-member values are runtime inputs; step budgets are traced, so
-        they do NOT bucket)."""
-        return solver_bucket_key(self.base)
+        they do NOT bucket). Coefficient-field batches run a different
+        PROGRAM (the field array is an extra traced input), so the flag
+        buckets — the field VALUES stay runtime inputs and do not."""
+        key = solver_bucket_key(self.base)
+        if self.has_coef_fields:
+            key = key + ("coef-field",)
+        return key
+
+
+def request_bucket_key(base: SolverConfig, scenario: Scenario) -> Tuple:
+    """The bucket key of ONE request: the base's structural key with the
+    scenario's integrator override applied and the coef-field program
+    flag appended — exactly what :meth:`ScenarioBatch.bucket_key` would
+    say for a batch of such requests. Queues group by THIS key, so a
+    request stating ``integrator='implicit-cg'`` (or carrying a
+    coefficient field) can never pack with the plain explicit sweep of
+    the same base."""
+    ti = scenario.integrator
+    if ti is not None and ti != base.integrator:
+        base = dataclasses.replace(base, integrator=ti)
+    key = solver_bucket_key(base)
+    if scenario.coef_field is not None:
+        key = key + ("coef-field",)
+    return key
 
 
 def solver_bucket_key(cfg: SolverConfig) -> Tuple:
@@ -192,4 +318,8 @@ def solver_bucket_key(cfg: SolverConfig) -> Tuple:
         cfg.halo_order,
         cfg.overlap,
         cfg.time_blocking,
+        # time integrator (PR 19): a leapfrog carry or a CG solve is a
+        # structurally different program — requests of different
+        # integrators must never pack into one bucket
+        cfg.integrator,
     )

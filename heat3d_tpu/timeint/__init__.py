@@ -1,0 +1,139 @@
+"""heat3d_tpu.timeint — the time-integrator registry (docs/INTEGRATORS.md).
+
+Generalizes the step carry beyond explicit Euler's single field:
+
+- ``explicit-euler`` — the default; HeatSolver3D keeps its existing
+  (bit-identical) parallel.step route and never enters this package.
+- ``leapfrog`` — the wave family's two-level carry ``(u, u_prev)``
+  (heat3d_tpu.timeint.leapfrog): one tap sweep + subtraction per update,
+  superstep ring recompute included.
+- ``implicit-cg`` — matrix-free conjugate-gradient backward Euler
+  (heat3d_tpu.timeint.cg): unconditionally stable, dt far above the
+  explicit CFL bound, keep-masked SPMD-uniform iteration.
+
+``heat3d_tpu.timeint.coeffield`` carries the sibling generalization —
+spatially-varying coefficient FIELDS as a second sharded array — which
+is a serve/test surface (Scenario.coef_field), not a SolverConfig knob.
+
+The builders here mirror parallel.step's contracts: shard_map over the
+(x, y, z) mesh, P('x','y','z') field specs, psum-replicated scalars,
+the shared ExchangePlan for every ghost ring, and the heat3d.step named
+scope for profile attribution.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh
+
+from heat3d_tpu.core.config import (  # noqa: F401
+    DEFAULT_INTEGRATOR,
+    INTEGRATORS,
+    SolverConfig,
+)
+from heat3d_tpu.timeint import cg, coeffield, leapfrog  # noqa: F401
+
+
+class MultiLevelCheckpointError(ValueError):
+    """A checkpoint's level structure does not match the integrator's
+    carry: missing level manifest, wrong ``levels`` count, or a
+    per-level shard shape mismatch. Subclasses ValueError so the
+    supervisor treats it as skip-this-generation, never quarantine
+    (the shards are not corrupt — they are the wrong SHAPE of state)."""
+
+
+def carry_levels(integrator: str) -> int:
+    """Field levels in the step carry: 2 for leapfrog, else 1."""
+    return 2 if integrator == "leapfrog" else 1
+
+
+def pin_config(cfg: SolverConfig) -> SolverConfig:
+    """Resolve 'auto' knobs for a non-default integrator the way the
+    serve tier's _resolve_base does: the multi-level/implicit builders
+    are jnp + ppermute programs, so auto pins there instead of running
+    the explicit-route tuner (whose cached knobs describe a different
+    program family), and tb=0 (auto) pins to 1."""
+    import dataclasses
+
+    kw = {}
+    if cfg.backend == "auto":
+        kw["backend"] = "jnp"
+    if cfg.halo == "auto":
+        kw["halo"] = "ppermute"
+    if cfg.time_blocking == 0:
+        kw["time_blocking"] = 1
+    return dataclasses.replace(cfg, **kw) if kw else cfg
+
+
+def validate_config(cfg: SolverConfig) -> None:
+    """Structural validation for the non-default integrator builders
+    (the family coupling itself — wave<->leapfrog, CG symmetry — is
+    config-time: eqn._validate_integrator). Raises ValueError listing
+    every violation at once."""
+    problems = []
+    if cfg.integrator not in INTEGRATORS:
+        problems.append(f"unknown integrator {cfg.integrator!r}")
+    if cfg.backend != "jnp":
+        problems.append(
+            f"backend must be 'jnp' (got {cfg.backend!r}): the kernel "
+            "routes fuse the single-level explicit update only"
+        )
+    if cfg.halo != "ppermute":
+        problems.append(
+            f"halo must be 'ppermute' (got {cfg.halo!r}): the DMA slab "
+            "kernels are explicit-step-shaped"
+        )
+    if cfg.halo_order != "axis":
+        problems.append(
+            f"halo_order must be 'axis' (got {cfg.halo_order!r})"
+        )
+    if cfg.overlap:
+        problems.append(
+            "overlap=True unsupported (the interior/boundary split is "
+            "explicit-step-shaped)"
+        )
+    if cfg.integrator == "implicit-cg" and cfg.time_blocking != 1:
+        problems.append(
+            f"implicit-cg needs time_blocking=1 (got {cfg.time_blocking}): "
+            "each solve already amortizes many matvecs per exchange"
+        )
+    if cfg.integrator == "leapfrog" and cfg.time_blocking < 1:
+        problems.append(
+            f"leapfrog needs time_blocking >= 1, got {cfg.time_blocking}"
+        )
+    if problems:
+        raise ValueError(
+            f"integrator {cfg.integrator!r} unsupported for this config: "
+            + "; ".join(problems)
+            + " (docs/INTEGRATORS.md)"
+        )
+
+
+def make_step_fn(cfg: SolverConfig, mesh: Mesh, with_residual: bool = False):
+    """The integrator's sharded one-step builder. Leapfrog maps the
+    two-level carry ``(u, u_prev) -> (u_new, u)``; implicit-cg maps
+    ``u -> u_new``. ``with_residual`` appends the psum'd global change
+    residual in both cases (the supervisor health contract)."""
+    validate_config(cfg)
+    if cfg.integrator == "leapfrog":
+        return leapfrog.make_step_fn(cfg, mesh, with_residual=with_residual)
+    if cfg.integrator == "implicit-cg":
+        return cg.make_step_fn(cfg, mesh, with_residual=with_residual)
+    raise ValueError(
+        f"integrator {cfg.integrator!r} has no timeint builder "
+        "(explicit-euler rides parallel.step)"
+    )
+
+
+def make_multistep_fn(cfg: SolverConfig, mesh: Mesh):
+    """The integrator's device-side-loop builder. Leapfrog:
+    ``(carry, n) -> carry``. implicit-cg: ``(u, n) -> (u, cg_iters,
+    cg_relres)`` — the trailing stats feed the ``cg_solve`` event."""
+    validate_config(cfg)
+    if cfg.integrator == "leapfrog":
+        return leapfrog.make_multistep_fn(cfg, mesh)
+    if cfg.integrator == "implicit-cg":
+        return cg.make_multistep_fn(cfg, mesh)
+    raise ValueError(
+        f"integrator {cfg.integrator!r} has no timeint builder "
+        "(explicit-euler rides parallel.step)"
+    )

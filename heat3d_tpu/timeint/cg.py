@@ -1,0 +1,273 @@
+"""Matrix-free conjugate-gradient backward Euler (integrator='implicit-cg').
+
+Backward Euler for u_t = L u solves (I - dt L) u^{n+1} = u^n each step —
+unconditionally stable, so dt can sit far above the explicit CFL bound.
+The system matrix is never formed: with T = I + dt L the EXPLICIT update
+taps already lowered by the eqn frontend, the SPD operator is
+
+    A v = 2 v - T v
+
+and one matvec is exactly one existing halo exchange + tap sweep — with
+the ghosts filled HOMOGENEOUSLY (bc_value=0.0 through the same
+ExchangePlan) because Krylov vectors live in the zero-boundary subspace.
+The inhomogeneous Dirichlet data enters through the right-hand side via
+the zero-field trick: T applied to the field that is zero on the interior
+and bc_value on the padding/ghosts yields exactly the dt * (boundary
+inflow) term, so b = u^n + T z.
+
+The iteration is a keep-masked ``lax.fori_loop`` to a fixed trip count
+(SPMD-uniform: every device runs identical traces; convergence is
+decided by psum-replicated scalars, and converged state is frozen via
+``jnp.where(keep, ...)``) — the same budget-loop idiom as the serve
+tier's ensemble. All reductions accumulate in ``cfg.precision.residual``
+and psum over the full (x, y, z) mesh, the residual dtype/replication
+contract of the explicit step.
+
+Env knobs (read at build time, not config fields — they tune the solve,
+not the problem): ``HEAT3D_CG_MAX_ITERS`` (default 64) and
+``HEAT3D_CG_TOL`` (relative residual, default 1e-6).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from heat3d_tpu.core.config import SolverConfig
+from heat3d_tpu.obs.trace import named_phase, scoped
+from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
+from heat3d_tpu.parallel.step import PHASE_STEP, _pin_padding, _solver_taps
+from heat3d_tpu.utils.compat import shard_map
+
+ENV_MAX_ITERS = "HEAT3D_CG_MAX_ITERS"
+ENV_TOL = "HEAT3D_CG_TOL"
+DEFAULT_MAX_ITERS = 64
+DEFAULT_TOL = 1e-6
+
+
+def cg_settings() -> Tuple[int, float]:
+    """(max_iters, rel_tol) from the env knobs, defaults when unset."""
+    iters = int(os.environ.get(ENV_MAX_ITERS) or DEFAULT_MAX_ITERS)
+    tol = float(os.environ.get(ENV_TOL) or DEFAULT_TOL)
+    if iters < 1:
+        raise ValueError(f"{ENV_MAX_ITERS} must be >= 1, got {iters}")
+    if not (0.0 < tol < 1.0):
+        raise ValueError(f"{ENV_TOL} must be in (0, 1), got {tol}")
+    return iters, tol
+
+
+def make_step_fn(
+    cfg: SolverConfig,
+    mesh: Mesh,
+    with_residual: bool = False,
+    with_stats: bool = False,
+):
+    """Build the sharded one-backward-Euler-step function ``u -> u_new``.
+
+    ``with_residual`` appends the global change residual (psum'd sumsq of
+    u_new - u in the residual dtype — the explicit step's supervisor
+    health contract). ``with_stats`` appends the CG iteration count and
+    final relative residual instead (both psum-derived, hence replicated
+    by construction — the ledger's ``cg_solve`` event payload).
+    """
+    taps = _solver_taps(cfg)  # T = I + dt L, the explicit update taps
+    spec = P(*cfg.mesh.axis_names)
+    axes = cfg.mesh.axis_names
+    cd = jnp.dtype(cfg.precision.compute)
+    sd = jnp.dtype(cfg.precision.storage)
+    rd = jnp.dtype(cfg.precision.residual)
+    max_iters, tol = cg_settings()
+
+    from heat3d_tpu.parallel.plan import exchange_with_plan
+
+    def _mask0(a):
+        # Krylov vectors are zero on the storage padding (the padded
+        # cells are boundary data, not unknowns)
+        return _pin_padding(a, cfg, bc_value=0.0)
+
+    def _psum_sum(a):
+        return lax.psum(jnp.sum(a, dtype=rd), axes)
+
+    def local(u_local):
+        def matvec(v):
+            with named_phase("halo_exchange"):
+                vp = exchange_with_plan(v, cfg, 1, bc_value=0.0)
+            tv = apply_taps_padded(vp, taps, compute_dtype=cd, out_dtype=cd)
+            return _mask0(2.0 * v - tv)
+
+        with named_phase("stencil"):
+            # zero-field trick: z is 0 on the interior and bc_value on
+            # padding/ghosts, so (T z) interior == dt * (boundary inflow)
+            z = _pin_padding(jnp.zeros(u_local.shape, cd), cfg)
+            with named_phase("halo_exchange"):
+                zp = exchange_with_plan(z, cfg, 1)
+            tz = apply_taps_padded(zp, taps, compute_dtype=cd, out_dtype=cd)
+            b = _mask0(u_local.astype(cd) + tz)
+
+            b2 = _psum_sum(b.astype(rd) ** 2)
+            tol2 = jnp.asarray(tol * tol, rd) * b2
+            x = b  # warm start: b == u^n in the homogeneous subspace
+            r = _mask0(b - matvec(x))
+            p = r
+            rs = _psum_sum(r.astype(rd) ** 2)
+
+            def body(_, state):
+                x, r, p, rs, iters = state
+                keep = rs > tol2
+                ap = matvec(p)
+                pap = _psum_sum(p.astype(rd) * ap.astype(rd))
+                alpha = jnp.where(pap > 0, rs / jnp.where(pap > 0, pap, 1), 0)
+                xn = x + alpha.astype(cd) * p
+                rn = r - alpha.astype(cd) * ap
+                rsn = _psum_sum(rn.astype(rd) ** 2)
+                beta = jnp.where(rs > 0, rsn / jnp.where(rs > 0, rs, 1), 0)
+                pn = rn + beta.astype(cd) * p
+                return (
+                    jnp.where(keep, xn, x),
+                    jnp.where(keep, rn, r),
+                    jnp.where(keep, pn, p),
+                    jnp.where(keep, rsn, rs),
+                    iters + keep.astype(jnp.int32),
+                )
+
+            state = (x, r, p, rs, jnp.zeros((), jnp.int32))
+            x, _, _, rs, iters = lax.fori_loop(0, max_iters, body, state)
+            # restore the REAL boundary value on the storage padding
+            u_new = _pin_padding(x.astype(sd), cfg)
+            relres = jnp.sqrt(rs / jnp.where(b2 > 0, b2, 1))
+        return u_new, iters, relres
+
+    if with_stats:
+        return scoped(
+            PHASE_STEP,
+            shard_map(
+                local,
+                mesh=mesh,
+                in_specs=spec,
+                out_specs=(spec, P(), P()),
+                check_vma=False,
+            ),
+        )
+
+    if with_residual:
+
+        def local_res(u_local):
+            u_new, _, _ = local(u_local)
+            with named_phase("residual"):
+                r = residual_sumsq(u_new, u_local, rd)
+                r = lax.psum(r, axes)
+            return u_new, r
+
+        return scoped(
+            PHASE_STEP,
+            shard_map(
+                local_res,
+                mesh=mesh,
+                in_specs=spec,
+                out_specs=(spec, P()),
+                check_vma=False,
+            ),
+        )
+
+    def local_plain(u_local):
+        return local(u_local)[0]
+
+    return scoped(
+        PHASE_STEP,
+        shard_map(
+            local_plain,
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+            check_vma=False,
+        ),
+    )
+
+
+def make_multistep_fn(cfg: SolverConfig, mesh: Mesh):
+    """Build ``(u, num_steps) -> (u, iters_last, relres_last)``: the
+    device-side fori_loop over backward-Euler solves, carrying the LAST
+    solve's CG statistics out for the host-side ``cg_solve`` ledger
+    event (models.heat3d.HeatSolver3D.run)."""
+    step = make_step_fn(cfg, mesh, with_stats=True)
+    rd = jnp.dtype(cfg.precision.residual)
+
+    def run(u, num_steps):
+        def body(_, c):
+            u, _, _ = c
+            return step(u)
+
+        init = (u, jnp.zeros((), jnp.int32), jnp.zeros((), rd))
+        return lax.fori_loop(0, num_steps, body, init)
+
+    return run
+
+
+# ---- numpy reference (tests) -------------------------------------------------
+
+
+def reference_apply_T(
+    v: np.ndarray,
+    taps: np.ndarray,
+    periodic: bool = True,
+    bc_value: float = 0.0,
+) -> np.ndarray:
+    """fp64 full-grid sweep of the explicit taps T (pad + 27-tap apply)."""
+    mode = "wrap" if periodic else "constant"
+    kw = {} if periodic else {"constant_values": bc_value}
+    vp = np.pad(v.astype(np.float64), 1, mode=mode, **kw)
+    out = np.zeros_like(v, dtype=np.float64)
+    n = v.shape
+    for di in range(3):
+        for dj in range(3):
+            for dk in range(3):
+                w = float(taps[di, dj, dk])
+                if w == 0.0:
+                    continue
+                out += w * vp[di:di + n[0], dj:dj + n[1], dk:dk + n[2]]
+    return out
+
+
+def reference_solve(
+    u0: np.ndarray,
+    taps: np.ndarray,
+    periodic: bool = True,
+    bc_value: float = 0.0,
+    tol: float = 1e-12,
+    max_iters: int = 500,
+) -> np.ndarray:
+    """fp64 full-grid CG solve of (2I - T) u1 = u0 + T z — the oracle
+    the distributed keep-masked solve is checked against."""
+
+    def matvec(v):
+        return 2.0 * v - reference_apply_T(v, taps, periodic, 0.0)
+
+    if periodic:
+        b = u0.astype(np.float64)
+    else:
+        z = np.zeros_like(u0, dtype=np.float64)
+        b = u0.astype(np.float64) + reference_apply_T(
+            z, taps, periodic, bc_value
+        )
+    x = b.copy()
+    r = b - matvec(x)
+    p = r.copy()
+    rs = float(np.sum(r * r))
+    b2 = float(np.sum(b * b)) or 1.0
+    for _ in range(max_iters):
+        if rs <= tol * tol * b2:
+            break
+        ap = matvec(p)
+        alpha = rs / float(np.sum(p * ap))
+        x += alpha * p
+        r -= alpha * ap
+        rs_new = float(np.sum(r * r))
+        p = r + (rs_new / rs) * p
+        rs = rs_new
+    return x

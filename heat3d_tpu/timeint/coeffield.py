@@ -1,0 +1,245 @@
+"""Spatially-varying coefficient fields: a(x) as a SECOND sharded array.
+
+div(a grad u) discretized in flux form on the 7-point footprint:
+
+    u' = u + dt/h_ax^2 * sum_ax [ (a_c+a_p)/2 (u_p - u_c)
+                                 - (a_m+a_c)/2 (u_c - u_m) ]
+
+The coefficient field rides the SAME machinery as the solution: sharded
+P('x','y','z'), ghost-exchanged through the config's persistent
+:class:`ExchangePlan` (``exchange_with_plan`` — so its sends show up in
+the plan audit ledger exactly like the solution's), and pinned on
+storage padding. At a physical Dirichlet boundary the coefficient ghosts
+are zero-filled, which zeroes the boundary-face flux contribution from
+outside; periodic ghosts wrap genuinely. The field REPLACES grid.alpha
+(uniform a == alpha reproduces the constant-coefficient operator up to
+fp association).
+
+Named initializers (fp64 numpy, seeded — the serve tier's
+``Scenario.coef_field`` spec tuples resolve here) cover the test and
+serve surfaces: uniform (iid U[lo,hi]), layered (smooth z-gradient),
+checker (lo/hi block checkerboard), lognormal (clipped).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from heat3d_tpu.core.config import SolverConfig
+from heat3d_tpu.obs.trace import named_phase, scoped
+from heat3d_tpu.parallel.step import PHASE_STEP, _pin_padding
+from heat3d_tpu.utils.compat import shard_map
+
+COEF_FIELDS = ("uniform", "layered", "checker", "lognormal")
+
+
+def make_coef_field(
+    name: str,
+    shape: Tuple[int, int, int],
+    seed: int = 0,
+    lo: float = 0.5,
+    hi: float = 1.5,
+) -> np.ndarray:
+    """The named fp64 coefficient field on the GLOBAL grid. Every field
+    is strictly positive (lo > 0 enforced) so the operator stays
+    elliptic and the explicit bound dt <= h^2/(6 max a) holds."""
+    if name not in COEF_FIELDS:
+        raise ValueError(
+            f"unknown coefficient field {name!r}; have {COEF_FIELDS}"
+        )
+    if not (0.0 < lo <= hi):
+        raise ValueError(f"need 0 < lo <= hi, got lo={lo} hi={hi}")
+    rng = np.random.default_rng(seed)
+    if name == "uniform":
+        return rng.uniform(lo, hi, size=shape)
+    if name == "layered":
+        z = (np.arange(shape[2], dtype=np.float64) + 0.5) / shape[2]
+        prof = lo + (hi - lo) * 0.5 * (1.0 + np.sin(2.0 * np.pi * z))
+        return np.broadcast_to(prof[None, None, :], shape).copy()
+    if name == "checker":
+        idx = np.add.outer(
+            np.add.outer(np.arange(shape[0]) // 2, np.arange(shape[1]) // 2),
+            np.arange(shape[2]) // 2,
+        )
+        return np.where(idx % 2 == 0, lo, hi).astype(np.float64)
+    # lognormal: median sqrt(lo*hi), clipped into [lo, hi]
+    mid = np.sqrt(lo * hi)
+    sigma = 0.25 * np.log(hi / lo) if hi > lo else 0.0
+    return np.clip(
+        mid * np.exp(sigma * rng.standard_normal(shape)), lo, hi
+    )
+
+
+def varcoef_stable_dt(
+    a_max: float, spacing: Tuple[float, float, float]
+) -> float:
+    """Explicit stability bound for the flux-form operator: dt <=
+    1 / (2 a_max sum 1/h_i^2)."""
+    return 1.0 / (2.0 * float(a_max) * sum(1.0 / h**2 for h in spacing))
+
+
+def _slab(ap: jax.Array, axis: int, off: int) -> jax.Array:
+    """Interior-shaped slice of a 1-ring-padded array shifted ``off``
+    along ``axis``."""
+    sl = []
+    for ax in range(3):
+        o = off if ax == axis else 0
+        sl.append(slice(1 + o, ap.shape[ax] - 1 + o))
+    return ap[tuple(sl)]
+
+
+def _local_flux_update(
+    u_local, a_local, cfg, dt, exchange_with_plan, bc_value=None
+):
+    """One flux-form update on a local shard: both arrays ghost-padded
+    through the plan, per-axis face-averaged fluxes, compute-dtype
+    accumulation, storage-dtype result with padding re-pinned.
+    ``bc_value=None`` uses the config's (the solo route); the serve
+    tier passes each member's TRACED boundary value — ``dt`` may be a
+    traced per-member scalar for the same reason."""
+    cd = jnp.dtype(cfg.precision.compute)
+    sd = jnp.dtype(cfg.precision.storage)
+    with named_phase("halo_exchange"):
+        if bc_value is None:
+            up = exchange_with_plan(u_local, cfg, 1)
+        else:
+            up = exchange_with_plan(u_local, cfg, 1, bc_value)
+        apad = exchange_with_plan(a_local, cfg, 1, bc_value=0.0)
+    with named_phase("stencil"):
+        up = up.astype(cd)
+        apad = apad.astype(cd)
+        uc = _slab(up, 0, 0)
+        ac = _slab(apad, 0, 0)
+        acc = uc
+        for axis in range(3):
+            h2 = cfg.grid.spacing[axis] ** 2
+            u_p, u_m = _slab(up, axis, 1), _slab(up, axis, -1)
+            a_p, a_m = _slab(apad, axis, 1), _slab(apad, axis, -1)
+            flux = 0.5 * (ac + a_p) * (u_p - uc) - 0.5 * (a_m + ac) * (
+                uc - u_m
+            )
+            # dt/h2 stays a host-side fp64 divide when dt is concrete
+            # (solo route, bitwise vs the oracle) and a traced divide
+            # when the serve tier feeds a per-member dt
+            acc = acc + jnp.asarray(dt / h2, cd) * flux
+        out = acc.astype(sd)
+        if bc_value is None:
+            return _pin_padding(out, cfg)
+        return _pin_padding(out, cfg, bc_value=bc_value)
+
+
+def validate_config(cfg: SolverConfig) -> None:
+    """Coefficient fields compose with the plain jnp explicit route
+    only: heat family, explicit-euler, tb=1, no overlap, jnp backend
+    (pinned by the caller), ppermute halo."""
+    problems = []
+    if cfg.equation != "heat":
+        problems.append(f"equation must be 'heat', got {cfg.equation!r}")
+    if cfg.integrator != "explicit-euler":
+        problems.append(
+            f"integrator must be 'explicit-euler', got {cfg.integrator!r}"
+        )
+    if cfg.time_blocking > 1:
+        problems.append(
+            f"time_blocking must be 1, got {cfg.time_blocking} (the "
+            "superstep ring recompute does not carry the second array)"
+        )
+    if cfg.overlap:
+        problems.append("overlap=True unsupported")
+    if cfg.backend not in ("jnp", "auto"):
+        problems.append(f"backend must be 'jnp', got {cfg.backend!r}")
+    if cfg.halo not in ("ppermute", "auto"):
+        problems.append(f"halo must be 'ppermute', got {cfg.halo!r}")
+    if problems:
+        raise ValueError(
+            "coefficient-field step unsupported for this config: "
+            + "; ".join(problems)
+            + " (docs/INTEGRATORS.md)"
+        )
+
+
+def make_varcoef_step_fn(cfg: SolverConfig, mesh: Mesh):
+    """Build the sharded variable-coefficient step ``(u, a) -> u_new``:
+    both arrays P('x','y','z'), both ghost-exchanged through the one
+    ExchangePlan, the coefficient passing through unchanged."""
+    validate_config(cfg)
+    from heat3d_tpu.parallel.plan import exchange_with_plan
+
+    spec = P(*cfg.mesh.axis_names)
+    dt = cfg.grid.effective_dt()
+
+    def local(u_local, a_local):
+        return _local_flux_update(u_local, a_local, cfg, dt, exchange_with_plan)
+
+    return scoped(
+        PHASE_STEP,
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        ),
+    )
+
+
+def make_varcoef_multistep_fn(cfg: SolverConfig, mesh: Mesh):
+    """Build ``(u, a, num_steps) -> u_after`` with the device-side
+    fori_loop (coefficient loop-invariant)."""
+    step = make_varcoef_step_fn(cfg, mesh)
+    from jax import lax
+
+    def run(u, a, num_steps):
+        return lax.fori_loop(0, num_steps, lambda _, v: step(v, a), u)
+
+    return run
+
+
+# ---- numpy reference (tests) -------------------------------------------------
+
+
+def reference_varcoef_step(
+    u: np.ndarray,
+    a: np.ndarray,
+    dt: float,
+    spacing: Tuple[float, float, float],
+    periodic: bool = True,
+    bc_value: float = 0.0,
+) -> np.ndarray:
+    """fp64 full-grid flux-form update — the oracle for the sharded
+    builder (solution ghosts bc_value, coefficient ghosts zero)."""
+    if periodic:
+        up = np.pad(u.astype(np.float64), 1, mode="wrap")
+        apd = np.pad(a.astype(np.float64), 1, mode="wrap")
+    else:
+        up = np.pad(
+            u.astype(np.float64), 1, mode="constant",
+            constant_values=bc_value,
+        )
+        apd = np.pad(
+            a.astype(np.float64), 1, mode="constant", constant_values=0.0
+        )
+    n = u.shape
+
+    def slab(arr, axis, off):
+        sl = []
+        for ax in range(3):
+            o = off if ax == axis else 0
+            sl.append(slice(1 + o, 1 + o + n[ax]))
+        return arr[tuple(sl)]
+
+    uc, ac = slab(up, 0, 0), slab(apd, 0, 0)
+    acc = uc.copy()
+    for axis in range(3):
+        h2 = spacing[axis] ** 2
+        u_p, u_m = slab(up, axis, 1), slab(up, axis, -1)
+        a_p, a_m = slab(apd, axis, 1), slab(apd, axis, -1)
+        acc += (dt / h2) * (
+            0.5 * (ac + a_p) * (u_p - uc) - 0.5 * (a_m + ac) * (uc - u_m)
+        )
+    return acc

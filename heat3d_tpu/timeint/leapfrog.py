@@ -1,0 +1,244 @@
+"""Leapfrog (Stormer-Verlet) integration of the wave family.
+
+The wave equation u_tt = c^2 Lap u is second order in time, so its state
+is TWO field levels. The classic leapfrog update
+
+    u^{n+1} = 2 u^n - u^{n-1} + dt^2 c^2 Lap u^n
+
+maps exactly onto the existing single-sweep tap machinery: lower the wave
+spec at a *squared* timestep (giving I + dt^2 c^2 Lap), bump the center
+tap by one (giving 2I + dt^2 c^2 Lap), and the whole update is one
+``apply_taps_padded`` sweep of u^n followed by an elementwise subtraction
+of u^{n-1} — the same chain emission, halo ``ExchangePlan``, and
+shrinking-ring superstep recompute as the explicit-Euler step, with the
+carry generalized to the tuple ``(u, u_prev)``.
+
+The carry rotation ``(u_new, u)`` is naturally copy-free under
+``lax.fori_loop`` (each buffer is written exactly when its old contents
+die), so the multistep loop needs no ping-pong scratch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from heat3d_tpu.core.config import SolverConfig
+from heat3d_tpu.obs.trace import named_phase, scoped
+from heat3d_tpu.ops.stencil_jnp import apply_taps_padded, residual_sumsq
+from heat3d_tpu.parallel.step import (
+    PHASE_STEP,
+    _fill_mid_ghosts,
+    _pin_padding,
+    exchange,
+)
+from heat3d_tpu.utils.compat import shard_map
+
+
+def leapfrog_taps(cfg: SolverConfig) -> np.ndarray:
+    """The 3x3x3 leapfrog update taps ``2I + dt^2 c^2 Lap``: the wave
+    spec lowered at dt^2 (one generic ``lower_taps`` call — I + dt^2
+    c^2 Lap) with the center bumped by 1. One sweep of these taps over
+    u^n, minus u^{n-1}, IS the leapfrog update."""
+    from heat3d_tpu import eqn
+    from heat3d_tpu.eqn.spec import lower_taps
+
+    dt = cfg.grid.effective_dt()
+    taps = np.array(
+        lower_taps(eqn.build_spec(cfg), dt * dt, cfg.grid.spacing),
+        copy=True,
+    )
+    taps[1, 1, 1] += 1.0
+    return taps
+
+
+def stable_dt(cfg: SolverConfig) -> float:
+    """The leapfrog CFL bound for the wave family at this grid:
+    dt <= 1 / (c sqrt(sum 1/h_i^2)) (from dt^2 lambda_max <= 4 with
+    lambda_max = c^2 sum 4/h_i^2 for the 7pt Laplacian)."""
+    from heat3d_tpu import eqn
+
+    c = float(eqn.resolved_params(cfg)["c"])
+    return 1.0 / (c * np.sqrt(sum(1.0 / h**2 for h in cfg.grid.spacing)))
+
+
+def _crop(a: jax.Array, r: int) -> jax.Array:
+    return a[r:-r, r:-r, r:-r]
+
+
+def make_step_fn(
+    cfg: SolverConfig, mesh: Mesh, with_residual: bool = False
+):
+    """Build the sharded one-leapfrog-step function over the two-level
+    carry: ``(u, u_prev) -> (u_new, u)`` (or ``-> ((u_new, u), r2)``
+    with the global change residual psum'd in the residual dtype). Both
+    levels ride P('x','y','z'); the residual out_spec is replicated by
+    its psum, exactly the explicit step's contract."""
+    taps = leapfrog_taps(cfg)
+    spec = P(*cfg.mesh.axis_names)
+    axes = cfg.mesh.axis_names
+    cd = jnp.dtype(cfg.precision.compute)
+    sd = jnp.dtype(cfg.precision.storage)
+
+    def local_step(u_local, up_local):
+        upad = exchange(u_local, cfg)
+        with named_phase("stencil"):
+            t = apply_taps_padded(upad, taps, compute_dtype=cd, out_dtype=cd)
+            u_new = (t - up_local.astype(cd)).astype(sd)
+            return _pin_padding(u_new, cfg)
+
+    if with_residual:
+
+        def local_res(carry):
+            u_local, up_local = carry
+            u_new = local_step(u_local, up_local)
+            with named_phase("residual"):
+                r = residual_sumsq(
+                    u_new, u_local, jnp.dtype(cfg.precision.residual)
+                )
+                r = lax.psum(r, axes)
+            return (u_new, u_local), r
+
+        return scoped(
+            PHASE_STEP,
+            shard_map(
+                local_res,
+                mesh=mesh,
+                in_specs=((spec, spec),),
+                out_specs=((spec, spec), P()),
+                check_vma=False,
+            ),
+        )
+
+    def local(carry):
+        u_local, up_local = carry
+        return local_step(u_local, up_local), u_local
+
+    return scoped(
+        PHASE_STEP,
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=((spec, spec),),
+            out_specs=(spec, spec),
+            check_vma=False,
+        ),
+    )
+
+
+def make_superstep_fn(cfg: SolverConfig, mesh: Mesh):
+    """Build the temporally-blocked leapfrog superstep: k updates per
+    exchange pair. Level 0 exchanges width-k ghosts and level 1 width
+    k-1 (the subtrahend of application j needs exactly the ring depth
+    application j produces); the shrinking-ring recompute then mirrors
+    ``parallel.step._local_stepk``, with the PREVIOUS level of the next
+    application obtained by cropping two rings off the current level —
+    its interior-domain ghost cells are genuine by the same recompute
+    argument that makes the explicit superstep bitwise."""
+    k = cfg.time_blocking
+    if k < 2:
+        raise ValueError(f"superstep needs time_blocking >= 2, got {k}")
+    min_extent = max(3, k)
+    if min(cfg.local_shape) < min_extent:
+        raise ValueError(
+            f"time_blocking={k} needs local extents >= {min_extent} "
+            f"(k ghost layers plus the shrinking recompute rings), got "
+            f"{cfg.local_shape}"
+        )
+    taps = leapfrog_taps(cfg)
+    spec = P(*cfg.mesh.axis_names)
+    cd = jnp.dtype(cfg.precision.compute)
+    sd = jnp.dtype(cfg.precision.storage)
+
+    def local(carry):
+        u_local, up_local = carry
+        cur = exchange(u_local, cfg, width=k)  # rings k
+        prv = exchange(up_local, cfg, width=k - 1)  # rings k-1
+        with named_phase("stencil"):
+            new = None
+            for j in range(k):
+                rings_new = k - j - 1  # rings carried by this update
+                t = apply_taps_padded(
+                    cur, taps, compute_dtype=cd, out_dtype=cd
+                )
+                new = (t - prv.astype(cd)).astype(sd)
+                if rings_new > 0:
+                    new = _fill_mid_ghosts(new, cfg, rings_new)
+                else:
+                    new = _pin_padding(new, cfg)
+                if j < k - 1:
+                    prv = _crop(cur, 2)  # rings k-j-2
+                    cur = new
+            # cur still carries one ghost ring of u^{k-1}: crop it and
+            # re-pin the storage padding to recover the level-1 state
+            return new, _pin_padding(_crop(cur, 1), cfg)
+
+    return scoped(
+        PHASE_STEP,
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=((spec, spec),),
+            out_specs=(spec, spec),
+            check_vma=False,
+        ),
+    )
+
+
+def make_multistep_fn(cfg: SolverConfig, mesh: Mesh):
+    """Build ``(carry, num_steps) -> carry`` with the device-side
+    fori_loop. With time_blocking k > 1 the loop advances in k-update
+    supersteps plus trailing single steps. The two-level rotation makes
+    the loop copy-free without a ping-pong scratch: each trip writes
+    u_new into the buffer u_prev just vacated."""
+    step = make_step_fn(cfg, mesh)
+
+    if cfg.time_blocking > 1:
+        k = cfg.time_blocking
+        superstep = make_superstep_fn(cfg, mesh)
+
+        def runk(carry, num_steps):
+            carry = lax.fori_loop(
+                0, num_steps // k, lambda _, c: superstep(c), carry
+            )
+            return lax.fori_loop(
+                0, num_steps % k, lambda _, c: step(c), carry
+            )
+
+        return runk
+
+    def run(carry, num_steps):
+        return lax.fori_loop(0, num_steps, lambda _, c: step(c), carry)
+
+    return run
+
+
+# ---- numpy reference (tests) -------------------------------------------------
+
+
+def reference_step(
+    u: np.ndarray,
+    u_prev: np.ndarray,
+    taps: np.ndarray,
+    periodic: bool = True,
+    bc_value: float = 0.0,
+) -> np.ndarray:
+    """One fp64 leapfrog update on the full (unsharded) grid: pad, apply
+    the 27 taps, subtract the previous level. The oracle the distributed
+    builders are checked against."""
+    mode = "wrap" if periodic else "constant"
+    kw = {} if periodic else {"constant_values": bc_value}
+    up = np.pad(u.astype(np.float64), 1, mode=mode, **kw)
+    out = np.zeros_like(u, dtype=np.float64)
+    n = u.shape
+    for di in range(3):
+        for dj in range(3):
+            for dk in range(3):
+                w = float(taps[di, dj, dk])
+                if w == 0.0:
+                    continue
+                out += w * up[di:di + n[0], dj:dj + n[1], dk:dk + n[2]]
+    return out - u_prev.astype(np.float64)

@@ -152,6 +152,13 @@ def cache_key(cfg: SolverConfig, batch_size: int = 1) -> str:
     ]
     if batch_size > 1:
         parts.append(f"b2^{round(math.log2(batch_size))}")
+    # integrator leg only when non-default (docs/INTEGRATORS.md): every
+    # committed explicit-euler key stays byte-identical, and a winner
+    # measured for one integrator's program family can never steer
+    # another's (a leapfrog carry and a CG solve have different
+    # halo:compute ratios than the explicit sweep)
+    if cfg.integrator != "explicit-euler":
+        parts.append(f"ti:{cfg.integrator}")
     return "|".join(parts)
 
 
@@ -174,6 +181,9 @@ def config_knobs(cfg: SolverConfig) -> Dict[str, Any]:
         "mesh": list(cfg.mesh.shape),
         "equation": cfg.equation,
         "eq_params": [[k, v] for k, v in cfg.eq_params],
+        # workload context like equation: the key's ti leg buckets on it,
+        # resolution never applies it (not in CONFIG_KNOBS)
+        "integrator": cfg.integrator,
     }
 
 
@@ -419,7 +429,16 @@ def resolve_config(
     failure — unreadable store, stale entry, cached knob invalid in this
     env — falls back to :func:`_static_fallback`. Never raises.
     ``batch_size`` routes ensemble workloads (serve/ensemble) to their
-    own batch-shape-bucketed entries — see :func:`cache_key`."""
+    own batch-shape-bucketed entries — see :func:`cache_key`.
+
+    Non-default integrators never consult the cache: every committed
+    entry describes the explicit program family, so their autos pin
+    through ``timeint.pin_config`` (jnp + ppermute + tb=1) instead —
+    the one rule shared with the solver constructor."""
+    if cfg.integrator != "explicit-euler":
+        from heat3d_tpu import timeint
+
+        return timeint.pin_config(cfg)
     try:
         autos = _auto_knobs(cfg)
         if not autos or os.environ.get(ENV_DISABLE):

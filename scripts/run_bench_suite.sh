@@ -409,6 +409,70 @@ else
   note "suite: eqn smoke skipped (SKIP_EQN_SMOKE=1)"
 fi
 
+# Time-integrator smoke (informational, beside the eqn smoke;
+# docs/INTEGRATORS.md): the two non-default integrator families
+# end-to-end through the solver CLI on a forced 4-device CPU mesh — a
+# leapfrog wave run (the two-level (u, u_prev) carry through the
+# sharded superstep) and an implicit-cg run at 10x the explicit CFL
+# bound (dt 5/3 vs the 1/6 forward-Euler limit at unit spacing), whose
+# cg_solve ledger event must record a converged solve (iterations
+# within the HEAT3D_CG_MAX_ITERS cap, relative residual at tolerance)
+# — the stiff-dt convergence contract, machine-checked. Always CPU
+# (the path under test is the integrator plumbing, not the chip),
+# sub-minute. Fails SOFT; SKIP_TIMEINT_SMOKE=1 skips.
+if [[ -z "${SKIP_TIMEINT_SMOKE:-}" ]]; then
+  TI_LED="${OUT%.jsonl}.timeint.ledger.jsonl"
+  : > "$TI_LED"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
+    python -m heat3d_tpu.cli --grid 16 --steps 6 --mesh 4 1 1 \
+    --backend jnp --equation wave --integrator leapfrog \
+    >> "$SUITE_LOG" 2>&1 \
+    || note "suite: leapfrog wave smoke failed (rc=$?) — informational"
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    HEAT3D_LEDGER="$TI_LED" \
+    timeout -k 30 "${ROW_TIMEOUT:-900}" \
+    python -m heat3d_tpu.cli --grid 16 --steps 4 --mesh 4 1 1 \
+    --backend jnp --integrator implicit-cg --dt 1.6666667 \
+    >> "$SUITE_LOG" 2>&1 \
+    || note "suite: implicit-cg smoke run failed (rc=$?) — informational"
+  python - "$TI_LED" <<'PYEOF' \
+    || note "suite: timeint smoke verdict failed — informational"
+import json, sys
+evs = []
+try:
+    with open(sys.argv[1]) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    evs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+except OSError:
+    pass
+cg = [e for e in evs if e.get("event") == "cg_solve"]
+# the run's LAST solve is the audited one (a warmup call may log a
+# zero-step event first)
+last = cg[-1] if cg else {}
+ok = (
+    len(cg) >= 1
+    and 1 <= last.get("cg_iters", 0) <= 64
+    and 0.0 <= last.get("cg_relres", 1.0) <= 1e-5
+)
+print(json.dumps({"timeint_smoke": {
+    "ok": ok, "cg_solves": len(cg),
+    "cg_iters": last.get("cg_iters"),
+    "cg_relres": last.get("cg_relres"),
+}}))
+sys.exit(0 if ok else 1)
+PYEOF
+else
+  note "suite: timeint smoke skipped (SKIP_TIMEINT_SMOKE=1)"
+fi
+
 # Elastic-heal smoke (informational, beside the other smokes;
 # docs/RESILIENCE.md "Elastic degradation"): a supervised run on a forced
 # 4-device CPU mesh loses 2 devices mid-run (injected partial-device-loss)

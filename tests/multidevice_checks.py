@@ -1388,6 +1388,275 @@ def check_eqn_serve_traced_bind():
     print("eqn_serve_traced_bind OK")
 
 
+def check_timeint_dist_bitwise():
+    """Leapfrog (tb=1 and the tb=2 two-level ring superstep), the
+    matrix-free CG solve at 15x the explicit CFL bound, and the
+    variable-coefficient flux update all run on a REAL (2,2,1) mesh
+    BITWISE-identical to the (1,1,1) solo run. Leapfrog/CG certify at
+    f32 storage with f64 compute/residual (the battery env sets
+    JAX_ENABLE_X64): at f32 compute XLA:CPU contracts the tap-sweep FMAs
+    differently across mesh shapes (1-ulp drift), so bitwise solo==dist
+    is the f64-compute tier's contract; the varcoef flux update is
+    bitwise even at plain f32."""
+    import dataclasses
+
+    from jax.sharding import Mesh, NamedSharding
+
+    from heat3d_tpu import timeint
+    from heat3d_tpu.timeint import cg, coeffield
+
+    n = 12
+    rng = np.random.default_rng(7)
+    prec = Precision(storage="float32", compute="float64",
+                     residual="float64")
+    mesh_s = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                  ("x", "y", "z"))
+    mesh_d = Mesh(np.array(jax.devices()[:4]).reshape(2, 2, 1),
+                  ("x", "y", "z"))
+    sh_s = NamedSharding(mesh_s, P("x", "y", "z"))
+    sh_d = NamedSharding(mesh_d, P("x", "y", "z"))
+
+    # leapfrog: tb=1 (plain steps) and tb=2 (shrinking-ring superstep,
+    # the k*r / (k-1)*r two-level ghost plan) — both carry levels bitwise
+    for tb in (1, 2):
+        cfg = SolverConfig(
+            grid=GridConfig(shape=(n, n, n), dt=0.01,
+                            spacing=(1 / n, 1 / n, 1 / n)),
+            stencil=StencilConfig(kind="7pt",
+                                  bc=BoundaryCondition.DIRICHLET,
+                                  bc_value=0.1),
+            mesh=MeshConfig(shape=(1, 1, 1)),
+            backend="jnp",
+            halo="ppermute",
+            time_blocking=tb,
+            equation="wave",
+            eq_params=(("c", 1.0),),
+            integrator="leapfrog",
+            precision=prec,
+        )
+        cfg_d = dataclasses.replace(cfg, mesh=MeshConfig(shape=(2, 2, 1)))
+        u0 = rng.standard_normal((n, n, n)).astype(np.float32)
+        um1 = rng.standard_normal((n, n, n)).astype(np.float32)
+        ms_s = jax.jit(timeint.make_multistep_fn(cfg, mesh_s))
+        ms_d = jax.jit(timeint.make_multistep_fn(cfg_d, mesh_d))
+        c_s = ms_s((jax.device_put(u0, sh_s), jax.device_put(um1, sh_s)),
+                   jnp.int32(7))
+        c_d = ms_d((jax.device_put(u0, sh_d), jax.device_put(um1, sh_d)),
+                   jnp.int32(7))
+        for lvl in (0, 1):
+            assert np.array_equal(np.asarray(c_s[lvl]),
+                                  np.asarray(c_d[lvl])), (
+                f"leapfrog tb={tb} carry level {lvl}: dist != solo bitwise"
+            )
+
+    # implicit CG at 15x CFL: field bitwise AND the psum-replicated
+    # convergence decision identical (same iteration count on every mesh)
+    cfg_c = SolverConfig(
+        grid=GridConfig(shape=(n, n, n), spacing=(1 / n, 1 / n, 1 / n)),
+        stencil=StencilConfig(kind="7pt", bc=BoundaryCondition.DIRICHLET,
+                              bc_value=0.5),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp",
+        halo="ppermute",
+        integrator="implicit-cg",
+        precision=prec,
+    )
+    cfg_c = dataclasses.replace(
+        cfg_c,
+        grid=dataclasses.replace(cfg_c.grid,
+                                 dt=15 * cfg_c.grid.stable_dt()),
+    )
+    cfg_cd = dataclasses.replace(cfg_c, mesh=MeshConfig(shape=(2, 2, 1)))
+    u0c = rng.uniform(0.0, 1.0, (n, n, n)).astype(np.float32)
+    u1s, it_s, rr_s = jax.jit(
+        cg.make_step_fn(cfg_c, mesh_s, with_stats=True)
+    )(jax.device_put(u0c, sh_s))
+    u1d, it_d, _ = jax.jit(
+        cg.make_step_fn(cfg_cd, mesh_d, with_stats=True)
+    )(jax.device_put(u0c, sh_d))
+    assert np.array_equal(np.asarray(u1s), np.asarray(u1d)), (
+        "implicit-cg solve: dist != solo bitwise"
+    )
+    assert int(it_s) == int(it_d) and 1 <= int(it_s) <= 64, (
+        f"CG iteration counts differ across meshes "
+        f"({int(it_s)} vs {int(it_d)})"
+    )
+    assert float(rr_s) < 1e-5
+
+    # varcoef flux update: bitwise at plain f32 (one association order)
+    cfg_v = SolverConfig(
+        grid=GridConfig(shape=(n, n, n), dt=5e-4,
+                        spacing=(1 / n, 1 / n, 1 / n)),
+        stencil=StencilConfig(kind="7pt", bc=BoundaryCondition.PERIODIC),
+        mesh=MeshConfig(shape=(1, 1, 1)),
+        backend="jnp",
+        halo="ppermute",
+    )
+    cfg_vd = dataclasses.replace(cfg_v, mesh=MeshConfig(shape=(2, 2, 1)))
+    a = coeffield.make_coef_field("checker", (n, n, n),
+                                  seed=1).astype(np.float32)
+    uv = rng.standard_normal((n, n, n)).astype(np.float32)
+    r_s = jax.jit(coeffield.make_varcoef_multistep_fn(cfg_v, mesh_s))(
+        jax.device_put(uv, sh_s), jax.device_put(a, sh_s), jnp.int32(5))
+    r_d = jax.jit(coeffield.make_varcoef_multistep_fn(cfg_vd, mesh_d))(
+        jax.device_put(uv, sh_d), jax.device_put(a, sh_d), jnp.int32(5))
+    assert np.array_equal(np.asarray(r_s), np.asarray(r_d)), (
+        "varcoef flux update: dist != solo bitwise"
+    )
+    print("timeint_dist_bitwise OK")
+
+
+def check_timeint_supervised_two_level_resume():
+    """A leapfrog run interrupted at step 4 and resumed to step 8 lands
+    BITWISE on the uninterrupted run's final carry — BOTH levels restored
+    from the two-level checkpoint generation. A newer generation written
+    by a DIFFERENT integrator (single-level explicit-euler) is skipped
+    (MultiLevelCheckpointError — wrong shape of state, not corrupt
+    shards) WITHOUT being quarantined and stays on disk."""
+    import dataclasses
+    import os
+    import shutil
+    import tempfile
+
+    from heat3d_tpu import timeint
+    from heat3d_tpu.models.heat3d import HeatSolver3D
+    from heat3d_tpu.resilience.supervisor import load_latest_generation
+
+    n = 12
+    cfg = SolverConfig(
+        grid=GridConfig(shape=(n, n, n), dt=0.01,
+                        spacing=(1 / n, 1 / n, 1 / n)),
+        stencil=StencilConfig(kind="7pt",
+                              bc=BoundaryCondition.DIRICHLET,
+                              bc_value=0.1),
+        mesh=MeshConfig(shape=(2, 2, 1)),
+        backend="jnp",
+        halo="ppermute",
+        equation="wave",
+        eq_params=(("c", 1.0),),
+        integrator="leapfrog",
+    )
+    tmp = tempfile.mkdtemp(prefix="timeint_resume_")
+    try:
+        root_a = os.path.join(tmp, "a")
+        res_a = HeatSolver3D(cfg).run_supervised(
+            8, root_a, checkpoint_every=2)
+        assert res_a.steps_done == 8 and not res_a.resumed_from
+
+        root_b = os.path.join(tmp, "b")
+        res_half = HeatSolver3D(cfg).run_supervised(
+            4, root_b, checkpoint_every=2)
+        assert res_half.steps_done == 4
+        res_b = HeatSolver3D(cfg).run_supervised(
+            8, root_b, checkpoint_every=2)
+        assert res_b.resumed_from == 4 and res_b.steps_done == 8
+        for lvl in (0, 1):
+            ga = res_a.solver.gather(res_a.u[lvl])
+            gb = res_b.solver.gather(res_b.u[lvl])
+            assert np.array_equal(ga, gb), (
+                f"resumed carry level {lvl} != uninterrupted run bitwise"
+            )
+
+        # a NEWER single-level (explicit-euler) generation must be
+        # skipped in place, never quarantined
+        cfg_exp = dataclasses.replace(
+            cfg, equation="heat", eq_params=(),
+            integrator="explicit-euler")
+        es = HeatSolver3D(cfg_exp)
+        fake = os.path.join(root_b, "gen-00000012")
+        es.save_checkpoint(fake, es.init_state("hot-cube"), 12)
+        lf = HeatSolver3D(cfg)
+        try:
+            lf.load_checkpoint(fake)
+            raise AssertionError(
+                "single-level checkpoint loaded into a two-level carry")
+        except timeint.MultiLevelCheckpointError:
+            pass
+        loaded, quarantined = load_latest_generation(lf, root_b)
+        assert loaded is not None, "no generation loaded after skip"
+        carry, step = loaded
+        assert step == 8, f"expected resume at step 8, got {step}"
+        assert quarantined == [], (
+            f"level-mismatch generation was quarantined: {quarantined}")
+        assert os.path.isdir(fake), "skipped generation must stay on disk"
+        assert isinstance(carry, tuple) and len(carry) == 2
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("timeint_supervised_two_level_resume OK")
+
+
+def check_timeint_coef_serve_packing():
+    """Per-member coefficient fields through the serve traced route on a
+    real (2,2,1) spatial mesh: each member of a B=2 coef-field batch
+    matches its own fp64 flux-form oracle, a B=1 batch reproduces the
+    packed member BITWISE (packing invariance), and the run's halo
+    traffic lands in the plan-audit ledger (exchange_plan_built /
+    plan_cache_hit) exactly like the solution field's."""
+    import json
+    import os
+    import tempfile
+
+    from heat3d_tpu import obs
+    from heat3d_tpu.serve.ensemble import EnsembleSolver
+    from heat3d_tpu.serve.scenario import Scenario, ScenarioBatch
+    from heat3d_tpu.timeint import coeffield
+
+    base = SolverConfig(
+        grid=GridConfig.cube(12),
+        mesh=MeshConfig(shape=(2, 2, 1)),
+        backend="jnp",
+    )
+    members = [
+        Scenario(init="hot-cube", coef_field=("checker", 0, 0.5, 1.5),
+                 bc_value=0.25, steps=5),
+        Scenario(init="gaussian", coef_field=("lognormal", 7, 0.3, 2.0),
+                 bc_value=0.0, steps=5, seed=1),
+    ]
+    batch = ScenarioBatch(base, members)
+    assert batch.has_coef_fields
+
+    tmp = tempfile.mkdtemp(prefix="timeint_serve_")
+    led = os.path.join(tmp, "led.jsonl")
+    obs.activate(led)
+    try:
+        es = EnsembleSolver(batch)
+        out = es.gather(es.run(es.init_state()))
+    finally:
+        obs.deactivate()
+
+    for m in range(2):
+        a = batch.member_coef_field(m)
+        u_ref = golden.make_init(
+            members[m].init, base.grid.shape, seed=members[m].seed
+        ).astype(np.float64)
+        dt = batch.member_dt(m)
+        for _ in range(members[m].steps):
+            u_ref = coeffield.reference_varcoef_step(
+                u_ref, a, dt, base.grid.spacing, periodic=False,
+                bc_value=members[m].bc_value,
+            )
+        rel = np.max(np.abs(out[m] - u_ref)) / max(
+            float(np.max(np.abs(u_ref))), 1e-30)
+        assert rel < 1e-5, (
+            f"coef-field member {m} diverges from its fp64 flux oracle "
+            f"(rel {rel:.2e})")
+
+    for m in range(2):
+        b1 = ScenarioBatch(base, [members[m]])
+        e1 = EnsembleSolver(b1)
+        o1 = e1.gather(e1.run(e1.init_state()))[0]
+        assert np.array_equal(o1, out[m]), (
+            f"coef-field member {m}: B=1 != packed B=2 bitwise")
+
+    with open(led) as fh:
+        evs = [json.loads(line) for line in fh if line.strip()]
+    plan_evs = [e for e in evs
+                if e.get("event") in ("exchange_plan_built",
+                                      "plan_cache_hit")]
+    assert plan_evs, "no plan-audit events from the coef-field run"
+    print("timeint_coef_serve_packing OK")
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "eqn":
         # focused tier-1 entry (tests/test_eqn.py runs it unmarked on a
@@ -1409,6 +1678,19 @@ def main():
         check_plan_bitwise_parity()
         check_plan_partitioned_identity()
         check_plan_ensemble_parity()
+        print("ALL MULTIDEVICE CHECKS PASSED")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "timeint":
+        # focused tier-1 entry (tests/test_timeint.py runs it unmarked on
+        # a 4-device mesh with JAX_ENABLE_X64=1): the multi-level /
+        # implicit integration battery — leapfrog + CG + varcoef
+        # dist==solo bitwise, two-level supervised resume with the
+        # level-mismatch skip, coef-field serve packing/oracle/plan-audit
+        n = len(jax.devices())
+        assert n >= 4, f"expected >= 4 CPU devices, got {n}"
+        check_timeint_dist_bitwise()
+        check_timeint_supervised_two_level_resume()
+        check_timeint_coef_serve_packing()
         print("ALL MULTIDEVICE CHECKS PASSED")
         return
     if len(sys.argv) > 1 and sys.argv[1] == "deep_tb":

@@ -452,6 +452,7 @@ def test_provenance_post_heal_and_weak_scaling_rules(tmp_path):
         "streamk_emulated": False, "halo_plan": "monolithic",
         "chain_ops": 7, "backend": "jnp", "sync_rtt_s": 0.01,
         "batch_shape": [1], "members_per_step": 1, "equation": "heat",
+        "integrator": "explicit-euler",
     }
     p2 = tmp_path / "thr.jsonl"
     p2.write_text("\n".join(json.dumps(r) for r in [

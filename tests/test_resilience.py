@@ -624,6 +624,9 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
         # equation-family provenance (PR 11): required on every
         # throughput row — legacy rows key to heat downstream
         "equation": "heat",
+        # time-integrator provenance (PR 19): required on every
+        # throughput row — integrators share grids but not programs
+        "integrator": "explicit-euler",
     }
     halo_good = {
         "bench": "halo", "ts": "2026-01-01T00:00:00Z", "platform": "tpu",

@@ -436,6 +436,7 @@ def test_solo_throughput_rows_carry_solo_batch_fields():
         "fused_dma_emulated": False, "streamk_path": False,
         "streamk_emulated": False, "halo_plan": "monolithic",
         "batch_shape": [1], "members_per_step": 1, "equation": "heat",
+        "integrator": "explicit-euler",
     }
     assert check_row(row) == []
     bad = dict(row)
