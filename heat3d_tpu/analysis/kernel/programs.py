@@ -454,6 +454,87 @@ def _fused_case(
     )
 
 
+def _fused_rdma_case(
+    kind: str, periodic: bool, superstep: bool, plan_mode: str,
+    tag="", shape=None,
+) -> KernelCase:
+    """The fused in-kernel RDMA superstep (ops/stencil_fused_rdma): the
+    template sweep bodies with the plan-scheduled transport — one
+    remote-copy descriptor per (direction, sub-block) of the
+    ``ExchangePlan``'s decomposition, each owning its own flat semaphore
+    cell. ``plan_mode='partitioned'`` builds the plan with the
+    granularity floor off so the certified program genuinely ships
+    sub-blocks (the judged discipline: per-descriptor start/wait
+    pairing, no semaphore-cell aliasing, remote targets still the ±1
+    ring bijection)."""
+    size = 4
+    axes = (("x", size),)
+    shape = shape or _SHAPE
+    width = 2 if superstep else 1
+
+    from heat3d_tpu.core.config import BoundaryCondition, MeshConfig
+    from heat3d_tpu.parallel.plan import build_plan
+
+    plan = build_plan(
+        MeshConfig(shape=(size, 1, 1)),
+        BoundaryCondition.PERIODIC if periodic else BoundaryCondition.DIRICHLET,
+        width=width,
+        transport="ppermute",
+        mode=plan_mode,
+        min_part_bytes=0,
+    )
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from heat3d_tpu.ops.stencil_fused_rdma import (
+            apply_step_fused_rdma,
+            apply_superstep_fused_rdma,
+        )
+
+        taps = _taps(kind)
+        mesh = _mesh((size,), ("x",))
+        nx, ny, nz = shape
+        aval = jax.ShapeDtypeStruct((size * nx, ny, nz), jnp.float32)
+        apply = (
+            apply_superstep_fused_rdma if superstep else apply_step_fused_rdma
+        )
+        fn = _sharded(
+            lambda u: apply(
+                u,
+                taps,
+                plan=plan,
+                axis_name="x",
+                axis_size=size,
+                mesh_axes=("x",),
+                periodic=periodic,
+                bc_value=1.5,
+            ),
+            mesh,
+            P("x", None, None),
+        )
+        return fn, (aval,)
+
+    ptag = "/periodic" if periodic else ""
+    mtag = "/planned" if plan_mode == "partitioned" else ""
+    name = "fused-rdma2" if superstep else "fused-rdma"
+    return KernelCase(
+        key=f"{name}/{kind}/x{size}{ptag}{mtag}{tag}",
+        path="heat3d_tpu/ops/stencil_fused_rdma.py",
+        entry=(
+            "apply_superstep_fused_rdma"
+            if superstep
+            else "apply_step_fused_rdma"
+        ),
+        build=build,
+        ctxs=ring_ctxs(axes),
+        comm=(CommAxis("x", size),),
+        plan_key=plan.key,
+    )
+
+
 @functools.lru_cache(maxsize=1)
 def _cached_matrix() -> Tuple[KernelCase, ...]:
     import jax
@@ -500,6 +581,33 @@ def _cached_matrix() -> Tuple[KernelCase, ...]:
             _fused_case(
                 "7pt", periodic=False, superstep=True,
                 shape=(8, 1024, 512), tag="/chunked",
+            ),
+            # the plan-scheduled fused RDMA superstep: monolithic (one
+            # descriptor per direction — the degenerate plan) and
+            # partitioned (per-sub-block descriptors, flat semaphore
+            # cells) arms, step and tb=2 forms, at every ring position
+            _fused_rdma_case(
+                "7pt", periodic=False, superstep=False,
+                plan_mode="monolithic",
+            ),
+            _fused_rdma_case(
+                "27pt", periodic=True, superstep=False,
+                plan_mode="partitioned",
+            ),
+            _fused_rdma_case(
+                "7pt", periodic=False, superstep=True,
+                plan_mode="partitioned",
+            ),
+            _fused_rdma_case(
+                "27pt", periodic=True, superstep=True,
+                plan_mode="monolithic",
+            ),
+            # multi-chunk + partitioned sends: the cross-column ring
+            # re-prime composed with per-sub-block descriptor waits
+            _fused_rdma_case(
+                "7pt", periodic=False, superstep=False,
+                plan_mode="partitioned", shape=(8, 1024, 512),
+                tag="/chunked",
             ),
         ]
         cases.append(

@@ -148,6 +148,11 @@ LEDGER_EVENTS: Dict[str, Dict[str, Any]] = {
     "plan_cache_hit": {"kind": "point", "module": "parallel/plan.py",
                        "desc": "exchange plan reused from the process "
                                "cache (once per plan key per run)"},
+    "fused_rdma_dispatch": {"kind": "point", "module": "parallel/step.py",
+                            "desc": "fused in-kernel RDMA superstep route "
+                                    "selected (plan key, tb, sub-block "
+                                    "count, emulated flag) — once per "
+                                    "plan key per run"},
     # autotuning
     "tune_search_start": {"kind": "point", "module": "tune/measure.py",
                           "desc": "search opened: space, budget, key"},
@@ -348,6 +353,12 @@ ENV_VARS: Dict[str, Dict[str, str]] = {
                                "ad-hoc dispatch; partitioned degrades to "
                                "monolithic — the parity tests' reference "
                                "arm)"},
+    "HEAT3D_FUSED_RDMA": {
+        "module": "parallel/step.py",
+        "desc": "overrides the fused_rdma config knob: 1/on forces the "
+                "fused in-kernel RDMA superstep route, anything else "
+                "stands it down (the A/B counterfactual arm; row "
+                "identity in resilience/sweepstate)"},
     "HEAT3D_PLAN_PART_MIN_BYTES": {
         "module": "parallel/plan.py",
         "desc": "partition granularity floor in bytes (default 1 MiB): "

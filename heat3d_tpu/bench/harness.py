@@ -103,9 +103,15 @@ def bench_throughput(
     # one consistent evaluation of the env-dependent route/selector state
     # for all the provenance fields (each walks the real dispatch)
     mehrstellen = _mehrstellen_route(cfg)
-    direct = _resolved_direct(cfg)
+    # the fused RDMA route wins the dispatch when it resolves
+    # (make_step_fn / make_superstep_fn try it ahead of the direct and
+    # streamk families), so the other route fields must mirror that order
+    fused_rdma = _resolved_fused_rdma(cfg)
+    direct = False if fused_rdma else _resolved_direct(cfg)
     fused = _resolved_fused_dma(cfg)
-    streamk = _resolved_streamk(cfg, direct=direct)
+    streamk = (
+        False if fused_rdma else _resolved_streamk(cfg, direct=direct)
+    )
     from heat3d_tpu.parallel.step import _kernel_env_gate
 
     # the fused routes have an off-TPU emulation tier (interpret mode /
@@ -114,6 +120,10 @@ def bench_throughput(
     # for a real Mosaic-kernel row without cross-checking the platform
     fused_emulated = bool(fused and _kernel_env_gate(cfg)[1])
     streamk_emulated = bool(streamk and _kernel_env_gate(cfg)[1])
+    fused_rdma_emulated = bool(
+        fused_rdma
+        and _kernel_env_gate(cfg, allow_partitioned_plan=True)[1]
+    )
     # cost-analysis provenance (obs/perf/roofline): XLA's own FLOPs/bytes
     # for ONE step of this config, so a row's achieved-vs-peak is
     # computable from the row alone (`obs summary` roofline section,
@@ -174,6 +184,12 @@ def bench_throughput(
         # row must say what ran — docs/TUNING.md "Persistent exchange
         # plans")
         "halo_plan": _effective_halo_plan(cfg),
+        # fused-RDMA knob provenance (the five-surface knob contract):
+        # the EFFECTIVE value — HEAT3D_FUSED_RDMA override included,
+        # 'auto' resolved — so an env-forced A/B row is keyable from the
+        # row alone (obs regress/sweepstate key on it; legacy rows key
+        # to off)
+        "fused_rdma": _effective_fused_rdma(cfg),
         "steps": steps,
         "steps_requested": steps_requested,
         # ensemble-workload provenance (REQUIRED by check_provenance.py on
@@ -221,6 +237,13 @@ def bench_throughput(
         # as fused_dma_emulated).
         "streamk_path": streamk,
         "streamk_emulated": streamk_emulated,
+        # fused in-kernel RDMA route: whether the plan-scheduled fused
+        # superstep actually resolved (vs the jnp plan-exchange fallback
+        # elsewhere) — the fused-vs-unfused A/B needs the RESOLVED route
+        # on record, and the _emulated twin marks reference-contract
+        # resolutions (same contract as fused_dma_emulated)
+        "fused_rdma_path": fused_rdma,
+        "fused_rdma_emulated": fused_rdma_emulated,
         # redundant-compute honesty (required by check_provenance.py on
         # tb>1 rows): fraction of the superstep's executed stencil flops
         # that are ghost-ring recompute — the discount between this row's
@@ -295,6 +318,30 @@ def _resolved_fused_dma(cfg: SolverConfig) -> bool:
             or _fused_dma_3d_fn(cfg) is not None
         )
     return False
+
+
+def _resolved_fused_rdma(cfg: SolverConfig) -> bool:
+    """Whether this config's hot path resolves to the fused in-kernel
+    RDMA superstep (parallel.step._fused_rdma_fn / _fused_rdma2_fn —
+    fused_rdma='on' / HEAT3D_FUSED_RDMA, 1D x-slab scope, plan-scheduled
+    sends, tb <= 2), matching what the time loop runs."""
+    from heat3d_tpu.parallel.step import _fused_rdma2_fn, _fused_rdma_fn
+
+    if cfg.time_blocking == 2:
+        return _fused_rdma2_fn(cfg) is not None
+    if cfg.time_blocking <= 1:
+        return _fused_rdma_fn(cfg) is not None
+    return False
+
+
+def _effective_fused_rdma(cfg: SolverConfig) -> str:
+    """The ONE effective-knob rule (parallel.step.resolve_fused_rdma):
+    rows record what the dispatcher saw — HEAT3D_FUSED_RDMA override
+    included, 'auto' resolved to its static fallback — mirroring the
+    halo_plan effective-mode posture."""
+    from heat3d_tpu.parallel.step import resolve_fused_rdma
+
+    return resolve_fused_rdma(cfg)
 
 
 def _resolved_direct(cfg: SolverConfig) -> bool:

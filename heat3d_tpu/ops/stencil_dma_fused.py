@@ -379,11 +379,18 @@ def _fused_kernel(
     compute_dtype,
     out_dtype,
     use_barrier,
+    rdma_factory=None,
 ):
     j = pl.program_id(0)
     i = pl.program_id(1)
     bc = u_win.dtype.type(bc_value)
-    my, start_rdma, wait_hi_ghost, wait_lo_ghost = _rdma_halo(
+    # rdma_factory lets a caller swap the transfer schedule under the
+    # UNCHANGED sweep/emit body (ops/stencil_fused_rdma rides the
+    # ExchangePlan's per-sub-block decomposition through here); the
+    # default is this module's monolithic two-descriptor protocol.
+    my, start_rdma, wait_hi_ghost, wait_lo_ghost = (
+        rdma_factory or _rdma_halo
+    )(
         u_any, glo_ref, ghi_ref, send_sem, recv_sem, nx=nx, width=1,
         axis_name=axis_name, mesh_axes=mesh_axes, axis_size=axis_size,
         use_barrier=use_barrier,
@@ -697,12 +704,17 @@ def _fused2_kernel(
     storage_dtype,
     out_dtype,
     use_barrier,
+    rdma_factory=None,
 ):
     j = pl.program_id(0)
     i = pl.program_id(1)
     bc_s = u_win.dtype.type(bc_value)
     ny = by * n_chunks
-    my, start_rdma, wait_hi_ghost, wait_lo_ghost = _rdma_halo(
+    # same swappable transfer schedule as _fused_kernel (the planned
+    # per-sub-block variant lives in ops/stencil_fused_rdma)
+    my, start_rdma, wait_hi_ghost, wait_lo_ghost = (
+        rdma_factory or _rdma_halo
+    )(
         u_any, glo_ref, ghi_ref, send_sem, recv_sem, nx=nx, width=2,
         axis_name=axis_name, mesh_axes=mesh_axes, axis_size=axis_size,
         use_barrier=use_barrier,

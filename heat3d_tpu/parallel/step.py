@@ -191,12 +191,15 @@ def _local_step(
         return _pin_padding(u_new, cfg)
 
 
-def _kernel_env_gate(cfg: SolverConfig):
+def _kernel_env_gate(cfg: SolverConfig, allow_partitioned_plan: bool = False):
     """Shared dispatch gate for the Mosaic kernel routes: returns
     ``(ok, interpret)`` — ok=False when the config/env rules out any real
     kernel (backend, padding, platform), interpret=True when
     HEAT3D_DIRECT_INTERPRET routes the kernel through the Pallas
-    interpreter off-TPU (tests)."""
+    interpreter off-TPU (tests). ``allow_partitioned_plan`` is the fused
+    RDMA route's carve-out: that kernel CONSUMES the partitioned plan
+    (its sends ride the sub-block schedule), so the knob selects rather
+    than vetoes it."""
     import os
 
     if cfg.backend not in ("pallas", "auto"):
@@ -208,11 +211,14 @@ def _kernel_env_gate(cfg: SolverConfig):
         # assuming axis-ordered corner propagation; the pairwise ordering
         # A/B is an EXCHANGE-path knob, so it pins the exchange path
         return False, False
-    if cfg.halo_plan == "partitioned":
+    if cfg.halo_plan == "partitioned" and not allow_partitioned_plan:
         # partitioned early-bird sends are likewise an exchange-path
         # structure (the kernels never issue per-face collectives to
         # partition) — the A/B must measure the exchange path, not
-        # silently run a kernel that ignores the knob
+        # silently run a kernel that ignores the knob. The fused RDMA
+        # kernel is the one route that implements the knob in-kernel
+        # (per-sub-block remote-copy descriptors), so it passes
+        # allow_partitioned_plan=True.
         return False, False
     interpret = bool(os.environ.get("HEAT3D_DIRECT_INTERPRET"))
     forced = bool(os.environ.get("HEAT3D_DIRECT_FORCE"))
@@ -665,6 +671,148 @@ def _fused_dma2_fn(cfg: SolverConfig):
     return _fused_dma_route(cfg, tb=2)
 
 
+def resolve_fused_rdma(cfg: SolverConfig) -> str:
+    """The concrete fused-RDMA knob value for ``cfg`` in the current env:
+    ``HEAT3D_FUSED_RDMA`` overrides the config field (the A/B escape
+    hatch — '1'/'on'/'true' asks for the route, '0'/'off' stands it
+    down), and any ``'auto'`` still standing here takes the static
+    fallback (off) — same belt-and-braces posture as the other auto
+    knobs (tune.cache resolves 'auto' at the entry points)."""
+    import os
+
+    env = os.environ.get("HEAT3D_FUSED_RDMA")
+    if env is not None:
+        return (
+            "on"
+            if env.strip().lower() in ("1", "on", "true", "yes")
+            else "off"
+        )
+    mode = getattr(cfg, "fused_rdma", "off")
+    return "off" if mode == "auto" else mode
+
+
+def _fused_rdma_route(cfg: SolverConfig, tb: int):
+    """Shared resolver for the fused in-kernel RDMA superstep routes
+    (ops/stencil_fused_rdma — the plan-scheduled sibling of the fused
+    DMA-overlap family): the tb=1 step kernel or the tb=2 superstep
+    kernel with ``plan`` bound, or None when the knob/config/env/scope
+    gates reject. Unlike the fused-DMA route this one is selected by an
+    explicit knob (``fused_rdma='on'`` / HEAT3D_FUSED_RDMA) rather than
+    by overlap+halo='dma', and it is the one kernel route that CONSUMES
+    ``halo_plan='partitioned'`` (per-sub-block remote-copy descriptors
+    ride the plan's schedule), so it passes the gate's
+    allow_partitioned_plan carve-out."""
+    if resolve_fused_rdma(cfg) != "on":
+        return None
+    if cfg.overlap or cfg.halo == "dma":
+        # those knobs select the fused-DMA family; config validation
+        # rejects the combination, and an env-forced 'on' defers the
+        # same way rather than fight the explicit transport choice
+        return None
+    ok, interpret = _kernel_env_gate(cfg, allow_partitioned_plan=True)
+    if not ok:
+        return None
+    try:
+        from heat3d_tpu.ops.stencil_fused_rdma import (
+            apply_step_fused_rdma,
+            apply_superstep_fused_rdma,
+            fused_rdma2_supported,
+            fused_rdma_supported,
+            plan_send_bounds,
+            reference_fused_rdma_step_xla,
+            reference_fused_rdma_superstep_xla,
+        )
+    except ImportError:
+        return None
+    supported, apply_fn, reference_fn = (
+        (
+            fused_rdma_supported,
+            apply_step_fused_rdma,
+            reference_fused_rdma_step_xla,
+        )
+        if tb == 1
+        else (
+            fused_rdma2_supported,
+            apply_superstep_fused_rdma,
+            reference_fused_rdma_superstep_xla,
+        )
+    )
+    itemsize = jnp.dtype(cfg.precision.storage).itemsize
+    if not supported(
+        cfg.local_shape,
+        cfg.mesh.shape,
+        _solver_taps(cfg),
+        itemsize,
+        itemsize,
+        jnp.dtype(cfg.precision.compute).itemsize,
+    ):
+        return None
+    import functools
+
+    from heat3d_tpu.parallel.plan import _event_once, plan_for
+
+    plan = plan_for(cfg, width=tb)
+    _event_once(
+        "fused_rdma_dispatch",
+        plan.key,
+        tb=tb,
+        emulated=bool(interpret),
+        parts=len(plan_send_bounds(plan, cfg.local_shape, itemsize)),
+    )
+    if interpret:
+        # same posture as the fused-DMA route: Pallas' interpreter
+        # cannot discharge remote DMA on the production 3-named-axis
+        # meshes (jax 0.9) — the off-TPU emulation tier dispatches the
+        # kernel's pure-XLA reference contract, certified bitwise
+        # against the real kernel on the 1D ring where interpret CAN
+        # run it (tests/multidevice_checks.py fused_rdma)
+        return functools.partial(reference_fn, plan=plan)
+    return functools.partial(apply_fn, plan=plan)
+
+
+def _fused_rdma_fn(cfg: SolverConfig):
+    """The fused in-kernel RDMA step entry for this config, or None.
+    Also serves the remainder single steps of a tb=2 run — the step and
+    superstep kernels coexist under distinct collective ids."""
+    return _fused_rdma_route(cfg, tb=1)
+
+
+def _fused_rdma2_fn(cfg: SolverConfig):
+    """The tb=2 analogue of _fused_rdma_fn: the plan-scheduled fused
+    superstep (k <= 2 is the route's temporal-blocking ceiling)."""
+    if cfg.time_blocking != 2:
+        return None
+    return _fused_rdma_route(cfg, tb=2)
+
+
+def _local_step_fused_rdma(
+    u_local: jax.Array,
+    taps: np.ndarray,
+    cfg: SolverConfig,
+    fused,
+) -> jax.Array:
+    """The fused in-kernel RDMA step/superstep (ops/stencil_fused_rdma):
+    same call surface as the fused-DMA wrapper — the ExchangePlan is
+    already bound in the route's partial. The named scope stays
+    "fused_dma" (PHASE_FUSED): exchange+stencil are one kernel here
+    too, the roofline/profile join keys per-phase cost on that one
+    vocabulary, and the bench row's ``fused_rdma_path`` field carries
+    the route identity."""
+    with named_phase("fused_dma"):
+        out = fused(
+            u_local,
+            taps,
+            axis_name=cfg.mesh.axis_names[0],
+            axis_size=cfg.mesh.shape[0],
+            mesh_axes=cfg.mesh.axis_names,
+            periodic=cfg.stencil.bc is BoundaryCondition.PERIODIC,
+            bc_value=cfg.stencil.bc_value,
+            compute_dtype=jnp.dtype(cfg.precision.compute),
+            out_dtype=jnp.dtype(cfg.precision.storage),
+        )
+        return _pin_padding(out, cfg)
+
+
 def _local_step_fused_dma(
     u_local: jax.Array,
     taps: np.ndarray,
@@ -817,7 +965,29 @@ def make_step_fn(
     spec = P(*cfg.mesh.axis_names)
     axes = cfg.mesh.axis_names
     local_step = _local_step
-    direct = _direct_kernel_fn(cfg, halo=1, multichip=True)
+    # fused_rdma='on' wins the route when its gates pass: the knob is an
+    # explicit opt-in, so it is dispatched ahead of the direct family
+    # (which would otherwise claim the same scope)
+    fused_rdma = _fused_rdma_fn(cfg)
+    if fused_rdma is not None:
+        _log_step_path_once(
+            "step path: fused in-kernel RDMA superstep kernel "
+            "(plan-scheduled remote face copies under the sweep)"
+            + (
+                " [XLA reference emulation]"
+                if _kernel_env_gate(cfg, allow_partitioned_plan=True)[1]
+                else ""
+            )
+        )
+
+        def local_step(u_local, taps, cfg, compute_padded):
+            return _local_step_fused_rdma(u_local, taps, cfg, fused_rdma)
+
+    direct = (
+        None
+        if fused_rdma is not None
+        else _direct_kernel_fn(cfg, halo=1, multichip=True)
+    )
     if direct is not None:
         _log_step_path_once(
             "step path: %s direct kernel (no padded copy)"
@@ -993,6 +1163,34 @@ def make_superstep_fn(
         )
     taps = _solver_taps(cfg)
     spec = P(*cfg.mesh.axis_names)
+
+    # fused_rdma='on' at tb=2: the plan-scheduled in-kernel RDMA
+    # superstep — both updates AND the width-2 remote copies in ONE
+    # kernel. Dispatched ahead of the direct2/streamk families: the knob
+    # is an explicit opt-in, so when its gates pass it wins the route.
+    if cfg.time_blocking == 2:
+        fused_rdma2 = _fused_rdma2_fn(cfg)
+        if fused_rdma2 is not None:
+            _log_step_path_once(
+                "superstep path: fused in-kernel RDMA superstep kernel "
+                "(plan-scheduled width-2 remote copies under the sweep)"
+                + (
+                    " [XLA reference emulation]"
+                    if _kernel_env_gate(cfg, allow_partitioned_plan=True)[1]
+                    else ""
+                )
+            )
+
+            def local_fr2(u_local):
+                return _local_step_fused_rdma(u_local, taps, cfg, fused_rdma2)
+
+            return scoped(
+                PHASE_STEP,
+                shard_map(
+                    local_fr2, mesh=mesh, in_specs=spec, out_specs=spec,
+                    check_vma=False,
+                ),
+            )
 
     # k=2 with the BC-fused direct2 kernel: both updates in one sweep of the
     # UNPADDED field — no width-2 ghost copy at all. On multi-chip meshes
@@ -1261,10 +1459,11 @@ def phase_programs(
     - ``stencil``: the local tap application alone on locally-padded
       blocks (no collective) — the compute leg of the roofline.
     - ``residual``: the fp32 reduction + psum alone.
-    - ``fused_dma``: only when this config resolves to a fused DMA-overlap
-      route, where exchange+stencil are ONE kernel and per-leg programs
-      would misattribute: the full step program is the honest program for
-      the span of the same name.
+    - ``fused_dma``: only when this config resolves to a fused
+      DMA-overlap route OR the fused in-kernel RDMA route (both scope
+      under this one phase name), where exchange+stencil are ONE kernel
+      and per-leg programs would misattribute: the full step program is
+      the honest program for the span of the same name.
 
     Callers jit + ``.lower(u).compile().cost_analysis()`` each to get the
     FLOPs/bytes the roofline report divides measured span time by.
@@ -1321,10 +1520,15 @@ def phase_programs(
         PHASE_RESIDUAL: _sharded(_residual_only, out_specs=P()),
     }
     fused = (
-        (_fused_dma2_fn(cfg) is not None)
+        (
+            _fused_dma2_fn(cfg) is not None
+            or _fused_rdma2_fn(cfg) is not None
+        )
         if cfg.time_blocking == 2
         else (
-            _fused_dma_fn(cfg) is not None or _fused_dma_3d_fn(cfg) is not None
+            _fused_dma_fn(cfg) is not None
+            or _fused_dma_3d_fn(cfg) is not None
+            or _fused_rdma_fn(cfg) is not None
         )
         if cfg.time_blocking == 1
         else False

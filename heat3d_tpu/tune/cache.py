@@ -39,7 +39,7 @@ provenance discipline for everything the tuner measures.
 
 Resolution (:func:`resolve_config`) replaces ONLY the auto knobs —
 ``backend='auto'``, ``halo='auto'``, ``time_blocking=0``,
-``halo_plan='auto'`` — with the
+``halo_plan='auto'``, ``fused_rdma='auto'`` — with the
 cached winner's values; explicit knobs are never overridden, and the
 mesh is never swapped (an explicitly chosen decomposition is the user's
 call; ``tune apply`` emits it as a flag instead). Every resolution lands
@@ -47,8 +47,8 @@ in the run ledger as ``tune_cache_hit`` / ``tune_cache_miss`` /
 ``tune_cache_stale`` (stale = jax-version mismatch, schema drift, or a
 cached knob invalid in the current env, e.g. ``halo='dma'`` off-TPU);
 misses and staleness fall back to the static defaults (halo
-``ppermute``, time_blocking 1, halo_plan ``monolithic``, backend left
-``auto``). Resolution fails
+``ppermute``, time_blocking 1, halo_plan ``monolithic``, fused_rdma
+``off``, backend left ``auto``). Resolution fails
 soft: no cache error can kill the run being configured.
 
 ``HEAT3D_TUNE_CACHE`` overrides the store path (default
@@ -76,6 +76,7 @@ SCHEMA_VERSION = 1
 # the knobs an entry's config must carry (lint + resolution contract)
 CONFIG_KNOBS = (
     "backend", "halo", "overlap", "time_blocking", "halo_order", "halo_plan",
+    "fused_rdma",
 )
 
 # in-process memo: (path) -> (mtime_ns, doc). One stat per lookup instead
@@ -178,6 +179,7 @@ def config_knobs(cfg: SolverConfig) -> Dict[str, Any]:
         "time_blocking": int(cfg.time_blocking),
         "halo_order": cfg.halo_order,
         "halo_plan": cfg.halo_plan,
+        "fused_rdma": cfg.fused_rdma,
         "mesh": list(cfg.mesh.shape),
         "equation": cfg.equation,
         "eq_params": [[k, v] for k, v in cfg.eq_params],
@@ -362,7 +364,7 @@ def lint(path: Optional[str] = None) -> List[str]:
             tb = cfgd.get("time_blocking")
             if tb is not None and (not isinstance(tb, int) or tb < 1):
                 bad.append(f"{where}: time_blocking {tb!r} not an int >= 1")
-            for knob in ("backend", "halo", "halo_plan"):
+            for knob in ("backend", "halo", "halo_plan", "fused_rdma"):
                 if cfgd.get(knob) == "auto":
                     bad.append(
                         f"{where}: {knob}='auto' is not a concrete route "
@@ -401,6 +403,8 @@ def _static_fallback(cfg: SolverConfig) -> SolverConfig:
         kw["time_blocking"] = 1
     if cfg.halo_plan == "auto":
         kw["halo_plan"] = "monolithic"
+    if cfg.fused_rdma == "auto":
+        kw["fused_rdma"] = "off"
     return dataclasses.replace(cfg, **kw) if kw else cfg
 
 
@@ -414,6 +418,8 @@ def _auto_knobs(cfg: SolverConfig) -> List[str]:
         autos.append("time_blocking")
     if cfg.halo_plan == "auto":
         autos.append("halo_plan")
+    if cfg.fused_rdma == "auto":
+        autos.append("fused_rdma")
     return autos
 
 
@@ -541,6 +547,7 @@ def _resolve(
         or kw.get("backend") == "auto"
         or kw.get("time_blocking") == 0
         or kw.get("halo_plan") == "auto"
+        or kw.get("fused_rdma") == "auto"
     ):
         return _stale("entry carries unresolved auto knobs")
     try:

@@ -51,6 +51,12 @@ DEFAULT_KNOBS: Dict[str, Tuple[Any, ...]] = {
     # the A/B is purely a transport-schedule measurement; dma+partitioned
     # combos are config-rejected and pruned.
     "halo_plan": ("monolithic", "partitioned"),
+    # fused in-kernel RDMA superstep (ops/stencil_fused_rdma): the halo
+    # transfers ride inside the stencil kernel itself. Value-identical to
+    # the unfused route (certified on the interpret tier), so the A/B is
+    # a pure overlap measurement; dma/overlap/pairwise/deep-tb combos are
+    # config-rejected and pruned.
+    "fused_rdma": ("off", "on"),
 }
 
 # knob-value parsers for CLI `--knob name=v1,v2` strings
@@ -87,7 +93,7 @@ def parse_knob_values(name: str, spec: str) -> Tuple[Any, ...]:
                 raise ValueError(f"mesh value {tok!r} (want PxQxR)")
             vals.append(dims)
         else:
-            if name in ("halo", "halo_plan") and tok == "auto":
+            if name in ("halo", "halo_plan", "fused_rdma") and tok == "auto":
                 raise ValueError(
                     f"searched {name} values must be concrete: 'auto' "
                     "means 'resolve through the cache this search is "
@@ -108,7 +114,7 @@ def check_concrete(space: Dict[str, Sequence[Any]]) -> None:
     for name, values in space.items():
         for v in values:
             if (name == "time_blocking" and isinstance(v, int) and v < 1) or (
-                name in ("halo", "halo_plan") and v == "auto"
+                name in ("halo", "halo_plan", "fused_rdma") and v == "auto"
             ):
                 raise ValueError(
                     f"search space knob {name}={v!r} is not concrete — "

@@ -333,6 +333,80 @@ if [[ -z "${SKIP_PLAN_SMOKE:-}" ]]; then
     || note "suite: plan A/B decide failed (rc=$?) — informational"
 fi
 
+# Fused in-kernel RDMA interpret-parity smoke (informational, beside
+# the plan smoke): the REAL fused-RDMA superstep kernel (interpret
+# tier, 4-device CPU ring) must stay BITWISE-equal to the certified
+# fused-DMA kernel bodies it shares its sweep with — tb=1 and tb=2,
+# both BCs, monolithic AND genuine-sub-block partitioned plans — with
+# a machine-checked JSON verdict. Catches a fused-route value drift
+# between chip sessions without needing a TPU (the throughput A/B is
+# POD_RUNBOOK stage 3-fused). Fails SOFT; SKIP_FUSED_SMOKE=1 skips.
+if [[ -z "${SKIP_FUSED_SMOKE:-}" ]]; then
+  timeout -k 30 "${ROW_TIMEOUT:-900}" python - <<'PYEOF' \
+    || note "suite: fused RDMA parity smoke failed (rc=$?) — informational"
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from heat3d_tpu.core.config import BoundaryCondition, GridConfig, MeshConfig
+from heat3d_tpu.core.stencils import STENCILS, stencil_taps
+from heat3d_tpu.parallel.plan import build_plan
+from heat3d_tpu.utils.compat import shard_map
+import heat3d_tpu.ops.stencil_dma_fused as dma_mod
+import heat3d_tpu.ops.stencil_fused_rdma as rdma_mod
+
+grid = (16, 16, 16)
+gc = GridConfig(shape=grid)
+taps = stencil_taps(STENCILS["7pt"], gc.alpha, gc.effective_dt(), gc.spacing)
+u = jnp.asarray(np.random.default_rng(7).random(grid, np.float32))
+mesh = Mesh(np.array(jax.devices()).reshape(4), ("x",))
+spec = P("x")
+ud = jax.device_put(u, NamedSharding(mesh, spec))
+
+def run(fn, **kw):
+    return np.asarray(
+        jax.jit(shard_map(lambda x: fn(x, taps, **kw), mesh=mesh,
+                          in_specs=spec, out_specs=spec, check_vma=False))(ud)
+    )
+
+cases, ok = [], True
+for periodic in (False, True):
+    bc = BoundaryCondition.PERIODIC if periodic else BoundaryCondition.DIRICHLET
+    for tb, dma_fn, rdma_fn in (
+        (1, dma_mod.apply_step_fused_dma, rdma_mod.apply_step_fused_rdma),
+        (2, dma_mod.apply_superstep_fused_dma,
+         rdma_mod.apply_superstep_fused_rdma),
+    ):
+        kw = dict(axis_name="x", axis_size=4, mesh_axes=("x",),
+                  periodic=periodic, bc_value=1.5, interpret=True)
+        base = run(dma_fn, **kw)
+        for mode in ("monolithic", "partitioned"):
+            plan = build_plan(MeshConfig(shape=(4, 1, 1)), bc, width=tb,
+                              transport="ppermute", mode=mode,
+                              min_part_bytes=0)
+            got = run(rdma_fn, plan=plan, **kw)
+            bitwise = bool(np.array_equal(got, base))
+            ok &= bitwise
+            cases.append({"tb": tb, "periodic": periodic, "plan": mode,
+                          "bitwise": bitwise,
+                          "max_abs_diff": float(np.max(np.abs(got - base)))})
+print(json.dumps({"fused_smoke": {"ok": ok, "cases": cases}}))
+sys.exit(0 if ok else 1)
+PYEOF
+else
+  note "suite: fused RDMA parity smoke skipped (SKIP_FUSED_SMOKE=1)"
+fi
+
 # Serve smoke (informational, beside the tune smoke): the built-in tiny
 # multi-bucket batch through the batched scenario engine — submit ->
 # shape-bucketed packing -> streamed results, CPU-safe and sub-minute —

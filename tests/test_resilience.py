@@ -617,6 +617,9 @@ def test_check_provenance_catches_null_ts_and_missing_routes(tmp_path):
         "fused_dma_path": False, "fused_dma_emulated": False,
         "streamk_path": False, "streamk_emulated": False,
         "halo_plan": "monolithic",
+        # fused-RDMA route provenance (PR 20): required on every
+        # throughput row — the fused superstep's rate must be keyable
+        "fused_rdma_path": False, "fused_rdma_emulated": False,
         "chain_ops": 7, "backend": "auto", "sync_rtt_s": 7.5e-2,
         # ensemble-workload provenance (PR 7): required on every
         # throughput row — solo rows carry [1]/1

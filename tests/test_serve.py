@@ -435,6 +435,7 @@ def test_solo_throughput_rows_carry_solo_batch_fields():
         "direct_path": False, "fused_dma_path": False,
         "fused_dma_emulated": False, "streamk_path": False,
         "streamk_emulated": False, "halo_plan": "monolithic",
+        "fused_rdma_path": False, "fused_rdma_emulated": False,
         "batch_shape": [1], "members_per_step": 1, "equation": "heat",
         "integrator": "explicit-euler",
     }

@@ -487,6 +487,7 @@ def test_provenance_requires_integrator_on_throughput_rows():
         "mehrstellen_route": False, "fused_dma_path": False,
         "fused_dma_emulated": False, "streamk_path": False,
         "streamk_emulated": False, "halo_plan": "monolithic",
+        "fused_rdma_path": False, "fused_rdma_emulated": False,
         "chain_ops": 7, "batch_shape": [1], "members_per_step": 1,
         "sync_rtt_s": 0.0, "equation": "heat",
     }
